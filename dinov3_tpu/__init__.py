@@ -1,0 +1,20 @@
+"""dinov3_tpu — a TPU-native DINOv3 self-supervised pretraining framework.
+
+Brand-new design with the capabilities of the reference ``dinov3-jax``
+(see /root/reference, surveyed in SURVEY.md), rebuilt TPU-first:
+
+- GSPMD ``NamedSharding`` over a ``data x fsdp x tensor x seq`` device mesh
+  instead of a hand-rolled per-module FSDP interceptor
+  (reference: ``dinov3_jax/fsdp/utils.py``).
+- Distributed Sinkhorn-Knopp / DINO / iBOT / KoLeo / Gram losses written as
+  global-array math so XLA inserts the collectives
+  (reference: ``dinov3_jax/loss/*`` used explicit ``lax.psum`` in shard_map).
+- Pallas flash-attention and fused kernels for the hot ops, with portable
+  fallbacks for CPU test meshes.
+- A prefetching, double-buffered multi-crop input pipeline
+  (reference used a torch DataLoader with num_workers=0).
+- Fused teacher-EMA inside the train step (the reference's EMA never fed
+  back into the teacher — SURVEY.md §2.9.1).
+"""
+
+__version__ = "0.1.0"

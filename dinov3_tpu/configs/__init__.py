@@ -1,0 +1,21 @@
+from dinov3_tpu.configs.config import (
+    ConfigNode,
+    apply_dot_overrides,
+    apply_scaling_rules_to_cfg,
+    data_parallel_world,
+    get_default_config,
+    global_batch_size,
+    load_config,
+    setup_job,
+)
+
+__all__ = [
+    "ConfigNode",
+    "apply_dot_overrides",
+    "apply_scaling_rules_to_cfg",
+    "data_parallel_world",
+    "get_default_config",
+    "global_batch_size",
+    "load_config",
+    "setup_job",
+]

@@ -1,0 +1,201 @@
+"""Config system: YAML + dot-override merging onto a typed-ish node tree.
+
+Plays the role of the reference's OmegaConf stack
+(reference: dinov3_jax/configs/config.py:67-146) without the OmegaConf
+dependency: the default schema lives in ``ssl_default_config.yaml`` (same key
+schema as the reference so its run recipes port over), a run YAML is merged on
+top, then CLI ``key.path=value`` overrides. Batch-size-aware lr scaling rules
+(``linear_wrt_256`` / ``sqrt_wrt_1024``) match the reference semantics
+(reference: dinov3_jax/configs/config.py:43-56).
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+import os
+from pathlib import Path
+from typing import Any, Iterable, Mapping
+
+import yaml
+
+_DEFAULT_YAML = Path(__file__).parent / "ssl_default_config.yaml"
+
+
+class ConfigNode(dict):
+    """A dict with attribute access and strict missing-key errors.
+
+    Nested dicts are wrapped lazily so ``cfg.optim.lr`` works. Unlike a
+    namespace, it remains a real dict: yaml-serializable, copyable, and
+    usable as a pytree-less static argument.
+    """
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            value = self[name]
+        except KeyError as e:
+            raise AttributeError(f"config has no key {name!r}") from e
+        if isinstance(value, dict) and not isinstance(value, ConfigNode):
+            value = ConfigNode(value)
+            self[name] = value
+        return value
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self[name] = value
+
+    def __deepcopy__(self, memo):
+        return ConfigNode(copy.deepcopy(dict(self), memo))
+
+    def to_dict(self) -> dict:
+        out = {}
+        for k, v in self.items():
+            out[k] = v.to_dict() if isinstance(v, ConfigNode) else (
+                dict(v) if isinstance(v, dict) else v
+            )
+        return out
+
+
+def _wrap(tree: Any) -> Any:
+    if isinstance(tree, Mapping):
+        return ConfigNode({k: _wrap(v) for k, v in tree.items()})
+    return tree
+
+
+def _merge(base: dict, overlay: Mapping) -> dict:
+    """Recursively merge ``overlay`` onto ``base`` (overlay wins)."""
+    for k, v in overlay.items():
+        if isinstance(v, Mapping) and isinstance(base.get(k), Mapping):
+            _merge(base[k], v)
+        else:
+            base[k] = copy.deepcopy(v) if isinstance(v, (dict, list)) else v
+    return base
+
+
+def _parse_value(text: str) -> Any:
+    """Parse a CLI override value with YAML-ish typing."""
+    try:
+        return yaml.safe_load(text)
+    except yaml.YAMLError:
+        try:
+            return ast.literal_eval(text)
+        except (ValueError, SyntaxError):
+            return text
+
+
+def apply_dot_overrides(cfg: ConfigNode, overrides: Iterable[str]) -> ConfigNode:
+    """Apply ``a.b.c=value`` overrides in place; numeric components index lists."""
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"override {item!r} is not of the form key.path=value")
+        path, _, raw = item.partition("=")
+        keys = path.strip().split(".")
+        node = cfg
+        for k in keys[:-1]:
+            if isinstance(node, list):
+                node = node[int(k)]
+                continue
+            nxt = node.get(k)
+            if isinstance(nxt, list):
+                node = nxt
+                continue
+            if not isinstance(nxt, dict):
+                nxt = ConfigNode()
+                node[k] = nxt
+            elif not isinstance(nxt, ConfigNode):
+                nxt = ConfigNode(nxt)
+                node[k] = nxt
+            node = nxt
+        leaf = keys[-1]
+        value = _parse_value(raw.strip())
+        if isinstance(node, list):
+            node[int(leaf)] = value
+        else:
+            node[leaf] = value
+    return cfg
+
+
+def get_default_config() -> ConfigNode:
+    with open(_DEFAULT_YAML) as f:
+        return _wrap(yaml.safe_load(f))
+
+
+def load_config(
+    config_file: str | os.PathLike | None = None,
+    overrides: Iterable[str] = (),
+) -> ConfigNode:
+    """default yaml <- run yaml <- dot overrides, then lr scaling."""
+    cfg = get_default_config().to_dict()
+    if config_file:
+        with open(config_file) as f:
+            run_cfg = yaml.safe_load(f) or {}
+        _merge(cfg, run_cfg)
+    cfg = _wrap(cfg)
+    # Reference recipes use `train.batch_size_per_gpu`; accept it as an alias.
+    if "batch_size_per_gpu" in cfg.train:
+        cfg.train.batch_size_per_device = cfg.train.pop("batch_size_per_gpu")
+    apply_dot_overrides(cfg, overrides)
+    apply_scaling_rules_to_cfg(cfg)
+    return cfg
+
+
+def data_parallel_world(cfg: ConfigNode) -> int:
+    """Number of devices holding independent batch shards.
+
+    Model-parallel axes (tensor, seq) replicate the batch, so they are
+    divided out of the device count.
+    """
+    import jax
+
+    replicas = 1
+    par = cfg.get("parallel") or {}
+    for axis in ("tensor", "seq"):
+        replicas *= int(par.get(axis, 1) or 1)
+    return max(1, jax.device_count() // replicas)
+
+
+def global_batch_size(cfg: ConfigNode) -> int:
+    return cfg.train.batch_size_per_device * data_parallel_world(cfg)
+
+
+def apply_scaling_rules_to_cfg(cfg: ConfigNode) -> ConfigNode:
+    """Batch-size lr scaling, resolved once at load time.
+
+    Matches the reference rules (dinov3_jax/configs/config.py:43-56):
+    ``linear_wrt_256``: lr *= B/256; ``sqrt_wrt_1024``: lr *= 4*sqrt(B/1024);
+    skipped entirely when a schedules-v2 block supplies absolute ramps
+    (reference:45-46). The scaled value is stored back so schedules are
+    built from absolute lr.
+    """
+    if cfg.get("_lr_scaled") or cfg.get("schedules"):
+        return cfg
+    rule = cfg.optim.scaling_rule
+    if rule == "linear_wrt_256":
+        cfg.optim.lr = cfg.optim.lr * global_batch_size(cfg) / 256.0
+    elif rule == "sqrt_wrt_1024":
+        cfg.optim.lr = cfg.optim.lr * 4.0 * (global_batch_size(cfg) / 1024.0) ** 0.5
+    elif rule in (None, "", "none"):
+        pass
+    else:
+        raise ValueError(f"unknown scaling rule {rule!r}")
+    cfg["_lr_scaled"] = True
+    return cfg
+
+
+def setup_job(cfg: ConfigNode) -> None:
+    """Create the output dir, dump the resolved config, seed python RNGs.
+
+    (reference: dinov3_jax/configs/config.py:110-146 — unlike the reference's
+    ``fix_random_seeds`` we seed numpy too, since the masking generator uses
+    numpy RNG; SURVEY.md §2.9.8.)
+    """
+    import random
+
+    import numpy as np
+
+    out = Path(cfg.train.output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    dump = {k: v for k, v in cfg.to_dict().items() if not k.startswith("_")}
+    with open(out / "config.yaml", "w") as f:
+        yaml.safe_dump(dump, f, sort_keys=False)
+    random.seed(cfg.train.seed)
+    np.random.seed(cfg.train.seed)

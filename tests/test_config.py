@@ -1,0 +1,101 @@
+import yaml
+
+from dinov3_tpu.configs import (
+    apply_dot_overrides,
+    get_default_config,
+    load_config,
+)
+
+
+def test_default_schema_keys():
+    cfg = get_default_config()
+    # reference-compatible sections (dinov3_jax/configs/ssl_default_config.yaml)
+    for section in [
+        "dino", "ibot", "gram", "train", "student", "teacher",
+        "distillation", "multidistillation", "hrft", "optim", "crops",
+        "evaluation", "checkpointing", "compute_precision",
+    ]:
+        assert section in cfg, section
+    assert cfg.dino.head_n_prototypes == 65536
+    assert cfg.student.arch == "vit_large"
+    assert cfg.ibot.mask_ratio_min_max == [0.1, 0.5]
+
+
+def test_dot_overrides_typing():
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        "optim.lr=0.005",
+        "student.arch=vit_small",
+        "train.batch_size_per_device=4",
+        "dino.koleo_loss_distributed=true",
+        "crops.local_crops_number=2",
+    ])
+    assert cfg.optim.lr == 0.005
+    assert cfg.student.arch == "vit_small"
+    assert cfg.train.batch_size_per_device == 4
+    assert cfg.dino.koleo_loss_distributed is True
+    assert cfg.crops.local_crops_number == 2
+
+
+def test_run_yaml_merge(tmp_path):
+    run = {"student": {"arch": "vit_base"}, "optim": {"lr": 0.002}}
+    p = tmp_path / "run.yaml"
+    p.write_text(yaml.safe_dump(run))
+    cfg = load_config(p, overrides=["optim.scaling_rule=none"])
+    assert cfg.student.arch == "vit_base"
+    assert cfg.optim.lr == 0.002
+    # untouched default survives the merge
+    assert cfg.ibot.separate_head is True
+
+
+def test_sqrt_lr_scaling(tmp_path):
+    import jax
+
+    cfg = load_config(overrides=["train.batch_size_per_device=128",
+                                 "optim.lr=0.004"])
+    # reference formula: lr *= 4 * sqrt(B/1024)  (dinov3_jax/configs/config.py:54)
+    B = 128 * jax.device_count()
+    assert abs(cfg.optim.lr - 0.004 * 4.0 * (B / 1024.0) ** 0.5) < 1e-12
+    # idempotent
+    from dinov3_tpu.configs import apply_scaling_rules_to_cfg
+    lr = cfg.optim.lr
+    apply_scaling_rules_to_cfg(cfg)
+    assert cfg.optim.lr == lr
+
+
+def test_schedules_v2_skips_lr_scaling(tmp_path):
+    import yaml as _yaml
+
+    p = tmp_path / "run.yaml"
+    p.write_text(_yaml.safe_dump(
+        {"schedules": {"lr": {"start": 0.0, "peak": 1e-3, "end": 1e-6,
+                              "warmup_epochs": 10}},
+         "optim": {"lr": 0.004}}))
+    cfg = load_config(p)
+    assert cfg.optim.lr == 0.004  # untouched (reference config.py:45-46)
+
+
+def test_batch_size_per_gpu_alias(tmp_path):
+    import yaml as _yaml
+
+    p = tmp_path / "run.yaml"
+    p.write_text(_yaml.safe_dump({"train": {"batch_size_per_gpu": 32}}))
+    cfg = load_config(p, overrides=["optim.scaling_rule=none"])
+    assert cfg.train.batch_size_per_device == 32
+    assert "batch_size_per_gpu" not in cfg.train
+
+
+def test_list_index_override():
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, ["ibot.mask_ratio_min_max.1=0.6"])
+    assert cfg.ibot.mask_ratio_min_max == [0.1, 0.6]
+
+
+def test_model_parallel_excluded_from_global_batch():
+    from dinov3_tpu.configs import global_batch_size
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, ["train.batch_size_per_device=4",
+                              "parallel.tensor=8"])
+    # 8 CPU devices / tensor=8 -> 1 data shard
+    assert global_batch_size(cfg) == 4
