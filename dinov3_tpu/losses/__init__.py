@@ -1,0 +1,21 @@
+from dinov3_tpu.losses.dino_loss import (
+    dino_loss,
+    sinkhorn_knopp_teacher,
+    softmax_center_teacher,
+    update_center,
+)
+from dinov3_tpu.losses.gram_loss import gram_loss
+from dinov3_tpu.losses.ibot_loss import (
+    ibot_patch_loss_dense,
+    ibot_patch_loss_masked,
+    sinkhorn_knopp_teacher_masked,
+)
+from dinov3_tpu.losses.koleo_loss import koleo_loss
+from dinov3_tpu.losses.sinkhorn import sinkhorn_knopp
+
+__all__ = [
+    "dino_loss", "sinkhorn_knopp_teacher", "softmax_center_teacher",
+    "update_center", "gram_loss", "ibot_patch_loss_dense",
+    "ibot_patch_loss_masked", "sinkhorn_knopp_teacher_masked", "koleo_loss",
+    "sinkhorn_knopp",
+]

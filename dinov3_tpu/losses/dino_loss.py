@@ -1,0 +1,66 @@
+"""DINO CLS-token loss (functional).
+
+(reference: dinov3_jax/loss/dino_clstoken_loss.py. The softmax-center state
+is threaded explicitly — ``center`` in, new ``center`` out — instead of a
+flax variable, fitting the functional train step; the EMA update's
+cross-device mean is a plain global ``jnp.mean`` under GSPMD.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dinov3_tpu.losses.sinkhorn import sinkhorn_knopp
+
+
+def sinkhorn_knopp_teacher(
+    teacher_logits: jnp.ndarray,
+    teacher_temp: float | jnp.ndarray,
+    n_iterations: int = 3,
+) -> jnp.ndarray:
+    """[B, K] global logits -> [B, K] Sinkhorn targets."""
+    return sinkhorn_knopp(teacher_logits, teacher_temp, n_iterations)
+
+
+def softmax_center_teacher(
+    teacher_logits: jnp.ndarray,
+    center: jnp.ndarray,
+    teacher_temp: float | jnp.ndarray,
+) -> jnp.ndarray:
+    return jax.nn.softmax((teacher_logits - center) / teacher_temp, axis=-1)
+
+
+def update_center(
+    center: jnp.ndarray,
+    teacher_logits: jnp.ndarray,
+    momentum: float = 0.9,
+) -> jnp.ndarray:
+    """EMA center update; mean over the global batch (reference:91-95)."""
+    batch_center = jnp.mean(teacher_logits, axis=0, keepdims=True)
+    return center * momentum + batch_center * (1.0 - momentum)
+
+
+def dino_loss(
+    student_logits: jnp.ndarray,
+    teacher_probs: jnp.ndarray,
+    student_temp: float = 0.1,
+    ignore_diagonal: bool = False,
+) -> jnp.ndarray:
+    """Cross-entropy over S x T crop pairs.
+
+    student_logits: [S, B, K]; teacher_probs: [T, B, K].
+    ``ignore_diagonal`` drops the same-crop pairs (A-A, B-B), normalizing by
+    the remaining pair count (reference:71-89). Static python bool — no
+    ``lax.cond`` needed since it is config-fixed per run.
+    """
+    S, B, _ = student_logits.shape
+    T = teacher_probs.shape[0]
+    log_p = jax.nn.log_softmax(student_logits / student_temp, axis=-1)
+    if ignore_diagonal:
+        pair_ce = -jnp.einsum("sbk,tbk->st", log_p, teacher_probs)
+        M = min(S, T)
+        pair_ce = pair_ce * (1.0 - jnp.eye(S, T, dtype=pair_ce.dtype))
+        return pair_ce.sum() / (B * S * T - B * M)
+    total = -jnp.einsum("sbk,tbk->", log_p, teacher_probs)
+    return total / (B * S * T)

@@ -1,0 +1,47 @@
+"""Gram-anchoring loss (functional).
+
+(reference: dinov3_jax/loss/gram_loss.py — whose ``remove_only_teacher_neg``
+branch used torch in-place indexing (broken under JAX, SURVEY.md §2.9.6)
+and whose setup asserted ``remove_neg != remove_only_teacher_neg``, failing
+the default False/False config. Both fixed: functional ``jnp.where``
+clipping, and False/False simply clips nothing.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gram_loss(
+    student_feats: jnp.ndarray,
+    teacher_feats: jnp.ndarray,
+    normalize: bool = True,
+    img_level: bool = True,
+    remove_neg: bool = False,
+    remove_only_teacher_neg: bool = False,
+    reduce_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """MSE between patch-similarity (Gram) matrices.
+
+    feats: [B, T, D]. ``img_level`` computes per-image [T, T] Grams;
+    otherwise tokens are flattened to one [B*T, B*T] Gram.
+    """
+    if remove_neg and remove_only_teacher_neg:
+        raise ValueError("remove_neg and remove_only_teacher_neg are exclusive")
+    s = student_feats.astype(reduce_dtype)
+    t = teacher_feats.astype(reduce_dtype)
+    if normalize:
+        s = s / (jnp.linalg.norm(s, axis=-1, keepdims=True) + 1e-12)
+        t = t / (jnp.linalg.norm(t, axis=-1, keepdims=True) + 1e-12)
+    if not img_level:
+        s = s.reshape(-1, s.shape[-1])
+        t = t.reshape(-1, t.shape[-1])
+    s_sim = s @ jnp.moveaxis(s, -1, -2)
+    t_sim = t @ jnp.moveaxis(t, -1, -2)
+    if remove_neg:
+        s_sim = jnp.maximum(s_sim, 0.0)
+        t_sim = jnp.maximum(t_sim, 0.0)
+    elif remove_only_teacher_neg:
+        s_sim = jnp.where((s_sim < 0.0) & (t_sim < 0.0), 0.0, s_sim)
+        t_sim = jnp.maximum(t_sim, 0.0)
+    return jnp.mean((s_sim - t_sim) ** 2)

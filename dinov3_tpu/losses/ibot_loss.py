@@ -1,0 +1,67 @@
+"""iBOT masked-patch loss (functional, fixed-capacity buffers).
+
+(reference: dinov3_jax/loss/ibot_patch_loss.py. Differences by design:
+- operates on a fixed-capacity padded buffer of masked tokens with an
+  explicit validity/weight vector — TPU-static shapes, no data-dependent
+  slicing (SURVEY.md §7.3);
+- the per-image mask weighting the reference commented out (:66, a latent
+  bug per SURVEY.md §2.9.6) is applied;
+- the sinkhorn variant's effective count is ``sum(weights > 0)``, the
+  global masked-patch count, matching the psum of ``n_masked_patches``.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dinov3_tpu.losses.sinkhorn import sinkhorn_knopp
+
+
+def sinkhorn_knopp_teacher_masked(
+    teacher_logits: jnp.ndarray,
+    teacher_temp: float | jnp.ndarray,
+    valid: jnp.ndarray,
+    n_iterations: int = 3,
+) -> jnp.ndarray:
+    """[M, K] padded masked-token logits; valid: [M] 0/1."""
+    return sinkhorn_knopp(
+        teacher_logits, teacher_temp, n_iterations, row_weights=valid
+    )
+
+
+def ibot_patch_loss_masked(
+    student_logits: jnp.ndarray,
+    teacher_probs: jnp.ndarray,
+    masks_weight: jnp.ndarray,
+    n_images: int,
+    student_temp: float = 0.1,
+) -> jnp.ndarray:
+    """CE on masked tokens.
+
+    student_logits/teacher_probs: [M, K] padded buffers; masks_weight: [M]
+    with 1/(masked tokens in that image) for valid entries, 0 for padding;
+    n_images: global number of mask rows (images with iBOT applied).
+    loss = -sum_m w_m * <q_m, log p_m> / n_images  == mean over images of the
+    mean CE over that image's masked tokens (PyTorch DINOv3 semantics).
+    """
+    log_p = jax.nn.log_softmax(student_logits / student_temp, axis=-1)
+    per_token = jnp.sum(teacher_probs * log_p, axis=-1)  # [M]
+    return -jnp.sum(per_token * masks_weight) / max(n_images, 1)
+
+
+def ibot_patch_loss_dense(
+    student_logits: jnp.ndarray,
+    teacher_probs: jnp.ndarray,
+    masks: jnp.ndarray,
+    student_temp: float = 0.1,
+) -> jnp.ndarray:
+    """Dense variant on full [B, T, K] token grids with [B, T] bool masks
+    (reference __call__:38-44)."""
+    log_p = jax.nn.log_softmax(student_logits / student_temp, axis=-1)
+    per_token = jnp.sum(teacher_probs * log_p, axis=-1)  # [B, T]
+    m = masks.astype(per_token.dtype)
+    per_image = jnp.sum(per_token * m, axis=-1) / jnp.clip(
+        jnp.sum(m, axis=-1), 1.0, None
+    )
+    return -jnp.mean(per_image)

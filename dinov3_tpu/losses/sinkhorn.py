@@ -1,0 +1,67 @@
+"""Distributed Sinkhorn-Knopp centering as global-array math.
+
+The reference implemented this inside ``shard_map`` with explicit
+``lax.psum`` over the "dp" axis and an ``init_phase`` escape hatch
+(dinov3_jax/loss/dino_clstoken_loss.py:35-62, ibot_patch_loss.py:77-109).
+Here the logits are a *global* jit array sharded over the data axes by
+GSPMD, so every ``jnp.sum`` is already a cross-device reduction — XLA
+inserts the collectives, no axis names, no init-phase special case
+(SURVEY.md §7.1).
+
+Padded rows (fixed-capacity masked-token buffers, SURVEY.md §7.3) are
+handled by ``row_weights``: zero-weight rows contribute nothing and receive
+a harmless uniform output.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sinkhorn_knopp(
+    logits: jnp.ndarray,
+    temperature: float | jnp.ndarray,
+    n_iterations: int = 3,
+    row_weights: jnp.ndarray | None = None,
+    reduce_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Sinkhorn-normalized teacher targets.
+
+    logits: [B, K] global teacher scores (B = all crops x global batch, or
+    the padded masked-token buffer for iBOT).
+    row_weights: optional [B] 0/1 validity; the effective sample count is
+    ``sum(row_weights)`` (the reference's ``n_masked_patches`` psum).
+    Returns [B, K] assignment probabilities (each valid row sums to 1).
+    """
+    logits = logits.astype(reduce_dtype)
+    B, K = logits.shape
+    NEG = jnp.asarray(-1e30, reduce_dtype)  # "-inf" that stays NaN-free
+    # Work entirely in the log domain: the iterations are algebraically
+    # identical to the reference's linear-domain ones (division ==
+    # logsumexp subtraction) but cannot over/underflow — the reference's
+    # raw ``exp(logits/T)`` overflowed for |logits|/T > ~88 and its Q
+    # underflowed to all-zero columns at low temperatures.
+    log_q = logits / temperature  # [B, K], rows = samples
+    if row_weights is not None:
+        valid = row_weights.astype(reduce_dtype) > 0
+        log_q = jnp.where(valid[:, None], log_q, NEG)
+        B_eff = jnp.maximum(jnp.sum(valid.astype(reduce_dtype)), 1.0)
+        log_B = jnp.log(B_eff)
+    else:
+        valid = None
+        log_B = jnp.log(jnp.asarray(B, reduce_dtype))
+    log_K = jnp.log(jnp.asarray(K, reduce_dtype))
+
+    log_q = log_q - jax.nn.logsumexp(log_q)  # sum_Q normalization
+    for _ in range(n_iterations):
+        # prototype marginal -> uniform 1/K (reduce over samples)
+        log_q = log_q - jax.nn.logsumexp(log_q, axis=0, keepdims=True) - log_K
+        # sample marginal -> uniform 1/B (reduce over prototypes)
+        log_q = log_q - jax.nn.logsumexp(log_q, axis=1, keepdims=True) - log_B
+        if valid is not None:
+            log_q = jnp.where(valid[:, None], log_q, NEG)
+    q = jnp.exp(log_q + log_B)  # each valid row sums to 1
+    if valid is not None:
+        q = jnp.where(valid[:, None], q, 0.0)
+    return q

@@ -1,0 +1,88 @@
+"""Model factories from config (reference: dinov3_jax/models/__init__.py).
+
+``build_backbone`` maps the ``student``/``teacher`` config sections onto
+``DinoVisionTransformer`` kwargs; the teacher variant drops stochastic depth
+(reference:41-49). ConvNeXt lives in ``dinov3_tpu/models/convnext.py``.
+"""
+
+from __future__ import annotations
+
+from dinov3_tpu.configs import ConfigNode
+from dinov3_tpu.models.vision_transformer import (
+    ARCHS,
+    DinoVisionTransformer,
+    vit_7b,
+    vit_base,
+    vit_giant2,
+    vit_huge2,
+    vit_large,
+    vit_small,
+    vit_so400m,
+    vit_test,
+)
+from dinov3_tpu.ops.common import Policy
+
+
+def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
+    s = cfg.student
+    kw = dict(
+        patch_size=s.patch_size,
+        drop_path_rate=0.0 if teacher else s.drop_path_rate,
+        layerscale_init=s.layerscale,
+        ffn_layer=s.ffn_layer,
+        ffn_ratio=s.ffn_ratio,
+        qkv_bias=s.qkv_bias,
+        proj_bias=s.proj_bias,
+        ffn_bias=s.ffn_bias,
+        norm_layer=s.norm_layer,
+        n_storage_tokens=s.n_storage_tokens,
+        mask_k_bias=s.mask_k_bias,
+        untie_cls_and_patch_norms=s.untie_cls_and_patch_norms,
+        untie_global_and_local_cls_norm=s.untie_global_and_local_cls_norm,
+        in_chans=s.in_chans,
+        pos_embed_type=s.pos_embed_type,
+        pos_embed_rope_base=s.pos_embed_rope_base,
+        pos_embed_rope_min_period=s.pos_embed_rope_min_period,
+        pos_embed_rope_max_period=s.pos_embed_rope_max_period,
+        pos_embed_rope_normalize_coords=s.pos_embed_rope_normalize_coords,
+        pos_embed_rope_shift_coords=None if teacher else s.pos_embed_rope_shift_coords,
+        pos_embed_rope_jitter_coords=None if teacher else s.pos_embed_rope_jitter_coords,
+        pos_embed_rope_rescale_coords=None if teacher else s.pos_embed_rope_rescale_coords,
+        pos_embed_rope_dtype=s.pos_embed_rope_dtype,
+    )
+    # execution options
+    train = cfg.train
+    kw["remat"] = {False: "none", True: "blocks"}.get(train.get("checkpointing", False), "none")
+    if train.get("checkpointing_full", False):
+        kw["remat"] = "full"
+    kernels = cfg.get("kernels") or {}
+    kw["attn_impl"] = kernels.get("flash_attention", "auto")
+    kw["scan_layers"] = bool(train.get("scan_layers", False))
+    policy = Policy.from_cfg(cfg.compute_precision)
+    kw["dtype"] = policy.compute_dtype
+    kw["param_dtype"] = policy.param_dtype
+    kw["reduce_dtype"] = policy.reduce_dtype
+    return kw
+
+
+def build_backbone(cfg: ConfigNode, *, teacher: bool = False) -> DinoVisionTransformer:
+    arch = cfg.student.arch
+    if arch not in ARCHS:
+        raise ValueError(f"unknown arch {arch!r}; available: {sorted(ARCHS)}")
+    return ARCHS[arch](**backbone_kwargs_from_cfg(cfg, teacher=teacher))
+
+
+def build_model_from_cfg(cfg: ConfigNode, only_teacher: bool = False):
+    """(student, teacher, embed_dim) — mirrors reference build_model_from_cfg."""
+    teacher_model = build_backbone(cfg, teacher=True)
+    if only_teacher:
+        return teacher_model, teacher_model.embed_dim
+    student_model = build_backbone(cfg, teacher=False)
+    return student_model, teacher_model, student_model.embed_dim
+
+
+__all__ = [
+    "ARCHS", "DinoVisionTransformer", "backbone_kwargs_from_cfg",
+    "build_backbone", "build_model_from_cfg", "vit_small", "vit_base",
+    "vit_large", "vit_so400m", "vit_huge2", "vit_giant2", "vit_7b", "vit_test",
+]

@@ -1,0 +1,26 @@
+from dinov3_tpu.ops.attention import SelfAttention, dispatch_attention, xla_attention
+from dinov3_tpu.ops.block import SelfAttentionBlock
+from dinov3_tpu.ops.common import Policy, canonical_dtype, constrain, part, trunc_normal_init
+from dinov3_tpu.ops.dino_head import DINOHead
+from dinov3_tpu.ops.drop_path import DropPath
+from dinov3_tpu.ops.ffn import Mlp, SwiGLUFFN, make_ffn_layer, swiglu_hidden_dim
+from dinov3_tpu.ops.layer_scale import LayerScale
+from dinov3_tpu.ops.norms import LayerNorm, RMSNorm, make_norm_layer
+from dinov3_tpu.ops.patch_embed import PatchEmbed
+from dinov3_tpu.ops.rope import (
+    patch_coords,
+    rope_apply,
+    rope_apply_with_prefix,
+    rope_periods,
+    rope_rotate_half,
+    rope_sincos,
+)
+
+__all__ = [
+    "SelfAttention", "dispatch_attention", "xla_attention",
+    "SelfAttentionBlock", "Policy", "canonical_dtype", "constrain", "part",
+    "trunc_normal_init", "DINOHead", "DropPath", "Mlp", "SwiGLUFFN",
+    "make_ffn_layer", "swiglu_hidden_dim", "LayerScale", "LayerNorm",
+    "RMSNorm", "make_norm_layer", "PatchEmbed", "patch_coords", "rope_apply",
+    "rope_apply_with_prefix", "rope_periods", "rope_rotate_half", "rope_sincos",
+]

@@ -1,0 +1,140 @@
+"""Multi-head self-attention with RoPE and pluggable kernels.
+
+(reference: dinov3_jax/layers/attention.py — which used
+``flax.linen.dot_product_attention`` with no fused kernel and a NaN-filled
+"bias mask" for ``mask_k_bias``, SURVEY.md §2.9.)
+
+TPU-first choices:
+- one fused qkv matmul, head reshape after (single MXU call);
+- softmax logits accumulate in ``reduce_dtype`` (fp32);
+- ``mask_k_bias`` zeroes the k third of the qkv bias with a *constant* 0/1
+  mask (softmax is shift-invariant in k-bias, so zeroing it is the intended
+  semantic; the reference multiplied by NaNs);
+- kernel dispatch: "pallas" selects the flash-attention kernel
+  (dinov3_tpu/ops/flash_attention.py) on TPU, "xla" the unfused einsum
+  path; "auto" picks per-backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.common import constrain, part, trunc_normal_init
+from dinov3_tpu.ops.rope import rope_apply_with_prefix
+
+
+def xla_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    reduce_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Unfused attention: [B, N, h, d] inputs, softmax in reduce_dtype."""
+    d = q.shape[-1]
+    scale = d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=reduce_dtype)
+    logits = (logits * scale).astype(reduce_dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _flash_available() -> bool:
+    try:
+        from dinov3_tpu.ops import flash_attention  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def dispatch_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    impl: str = "auto", reduce_dtype=jnp.float32,
+) -> jnp.ndarray:
+    if impl == "auto":
+        impl = (
+            "pallas"
+            if jax.default_backend() == "tpu" and _flash_available()
+            else "xla"
+        )
+    if impl in ("xla", "reference"):
+        return xla_attention(q, k, v, reduce_dtype)
+    if impl == "pallas":
+        from dinov3_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+class SelfAttention(nn.Module):
+    dim: int
+    num_heads: int = 8
+    qkv_bias: bool = True
+    proj_bias: bool = True
+    proj_drop: float = 0.0
+    mask_k_bias: bool = False
+    attn_impl: str = "auto"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    reduce_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        rope: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        B, N, _ = x.shape
+        h, d = self.num_heads, self.dim // self.num_heads
+
+        qkv_kernel = self.param(
+            "qkv_kernel", part(trunc_normal_init(), ("embed", "heads")),
+            (self.dim, 3 * self.dim), self.param_dtype,
+        )
+        qkv = x.astype(self.dtype) @ qkv_kernel.astype(self.dtype)
+        if self.qkv_bias:
+            qkv_b = self.param(
+                "qkv_bias", part(nn.initializers.zeros, ("heads",)),
+                (3 * self.dim,), self.param_dtype,
+            )
+            if self.mask_k_bias:
+                # zero the k third: softmax(q.(k+b)) is invariant to a shared
+                # k shift only for the rotary-free part, so DINOv3 masks it
+                # outright (reference: LinearKMaskedBias, attention.py:23-46).
+                mask = jnp.concatenate([
+                    jnp.ones((self.dim,), self.param_dtype),
+                    jnp.zeros((self.dim,), self.param_dtype),
+                    jnp.ones((self.dim,), self.param_dtype),
+                ])
+                qkv_b = qkv_b * mask
+            qkv = qkv + qkv_b.astype(self.dtype)
+
+        qkv = qkv.reshape(B, N, 3, h, d)
+        q, k, v = jnp.moveaxis(qkv, 2, 0)  # each [B, N, h, d]
+        if rope is not None:
+            sin, cos = rope
+            q, k = rope_apply_with_prefix(q, k, sin, cos, dtype=self.reduce_dtype)
+
+        out = dispatch_attention(q, k, v, self.attn_impl, self.reduce_dtype)
+        out = constrain(out.reshape(B, N, self.dim), ("batch", None, "embed_act"))
+
+        proj_kernel = self.param(
+            "proj_kernel", part(trunc_normal_init(), ("heads", "embed")),
+            (self.dim, self.dim), self.param_dtype,
+        )
+        y = out.astype(self.dtype) @ proj_kernel.astype(self.dtype)
+        if self.proj_bias:
+            proj_b = self.param(
+                "proj_bias", part(nn.initializers.zeros, ("embed",)),
+                (self.dim,), self.param_dtype,
+            )
+            y = y + proj_b.astype(self.dtype)
+        if self.proj_drop > 0.0:
+            y = nn.Dropout(self.proj_drop)(y, deterministic=deterministic)
+        return y

@@ -1,0 +1,71 @@
+"""Pre-norm transformer block with LayerScale and per-sample drop-path.
+
+(reference: dinov3_jax/layers/block.py — whose list-forward/stochastic-depth
+subset indexing is replaced by static-shape per-sample masking; multi-crop
+lists are handled at the model level by batching same-resolution crops.)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.attention import SelfAttention
+from dinov3_tpu.ops.drop_path import DropPath
+from dinov3_tpu.ops.ffn import make_ffn_layer
+from dinov3_tpu.ops.layer_scale import LayerScale
+from dinov3_tpu.ops.norms import make_norm_layer
+
+
+class SelfAttentionBlock(nn.Module):
+    dim: int
+    num_heads: int
+    ffn_ratio: float = 4.0
+    ffn_layer: str = "mlp"
+    norm_layer: str = "layernorm"
+    qkv_bias: bool = True
+    proj_bias: bool = True
+    ffn_bias: bool = True
+    drop_path_rate: float = 0.0
+    layerscale_init: float | None = 1e-5
+    mask_k_bias: bool = False
+    attn_impl: str = "auto"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    reduce_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        rope: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        norm_kw = dict(param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype)
+        ls = (
+            (lambda name: LayerScale(self.layerscale_init, self.param_dtype, name=name))
+            if self.layerscale_init is not None
+            else (lambda name: (lambda y: y))
+        )
+        dp = DropPath(self.drop_path_rate)
+
+        attn_out = SelfAttention(
+            dim=self.dim, num_heads=self.num_heads, qkv_bias=self.qkv_bias,
+            proj_bias=self.proj_bias, mask_k_bias=self.mask_k_bias,
+            attn_impl=self.attn_impl, dtype=self.dtype,
+            param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
+            name="attn",
+        )(make_norm_layer(self.norm_layer, name="norm1", **norm_kw)(x),
+          rope=rope, deterministic=deterministic)
+        x = x + dp(ls("ls1")(attn_out), deterministic=deterministic)
+
+        ffn_out = make_ffn_layer(
+            self.ffn_layer, int(self.dim * self.ffn_ratio),
+            use_bias=self.ffn_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="mlp",
+        )(make_norm_layer(self.norm_layer, name="norm2", **norm_kw)(x),
+          deterministic=deterministic)
+        x = x + dp(ls("ls2")(ffn_out), deterministic=deterministic)
+        return x

@@ -1,0 +1,74 @@
+"""Shared precision policy, initializers, and partitioning helpers.
+
+The framework-wide mixed-precision contract:
+- parameters are stored in ``param_dtype`` (fp32 master copies),
+- matmuls/activations run in ``compute_dtype`` (bf16 on TPU, MXU-native),
+- softmax / norm statistics / loss reductions accumulate in ``reduce_dtype``
+  (fp32) — replacing the reference's ad-hoc per-layer casts
+  (reference: dinov3_jax/layers/rms_norm.py:21, fp32 accumulation).
+
+Parameters carry *logical* axis names via flax's logical partitioning; the
+``parallel`` package maps logical names onto the physical
+``(data, fsdp, tensor, seq)`` mesh (see dinov3_tpu/parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+DTYPE_MAP = {
+    "fp32": jnp.float32, "float32": jnp.float32, "f32": jnp.float32,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "fp16": jnp.float16, "float16": jnp.float16,
+    "fp64": jnp.float64, "float64": jnp.float64,
+}
+
+
+def canonical_dtype(name: str | jnp.dtype | None) -> Any:
+    if name is None or not isinstance(name, str):
+        return name
+    try:
+        return DTYPE_MAP[name.lower()]
+    except KeyError as e:
+        raise ValueError(f"unknown dtype name {name!r}") from e
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Mixed precision policy threaded through every module."""
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    reduce_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_cfg(cls, precision_cfg) -> "Policy":
+        return cls(
+            param_dtype=canonical_dtype(precision_cfg.get("param_dtype", "fp32")),
+            compute_dtype=canonical_dtype(precision_cfg.get("compute_dtype", "bf16")),
+            reduce_dtype=canonical_dtype(precision_cfg.get("reduce_dtype", "fp32")),
+        )
+
+
+# DINOv3 init: truncated normal std=0.02 clipped at +-1 in unscaled units
+# (reference: dinov3_jax/layers/dino_head.py:25-29).
+def trunc_normal_init(stddev: float = 0.02) -> Callable:
+    import jax
+
+    return jax.nn.initializers.truncated_normal(
+        stddev=stddev, lower=-1.0 / max(stddev, 1e-8), upper=1.0 / max(stddev, 1e-8)
+    )
+
+
+def part(init: Callable, names: Sequence[str | None]) -> Callable:
+    """Attach logical partition names to a param initializer."""
+    return nn.with_logical_partitioning(init, tuple(names))
+
+
+def constrain(x: jnp.ndarray, names: Sequence[str | None]) -> jnp.ndarray:
+    """Logical sharding constraint on an activation (no-op outside a mesh)."""
+    return nn.with_logical_constraint(x, tuple(names))

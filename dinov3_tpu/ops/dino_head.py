@@ -1,0 +1,69 @@
+"""DINO/iBOT projection head.
+
+n-layer GELU MLP -> bottleneck -> L2-normalize -> prototype Dense (no bias),
+optionally weight-normalized (reference: dinov3_jax/layers/dino_head.py;
+weight-norm semantics from Meta's DINOv3 ``weight_norm(last_layer)`` with
+unit-norm rows when ``norm_last_layer``).
+
+The prototype matrix is [bottleneck, K] with K up to 262144
+(dinov3_vit7b16 recipes) — it is annotated with the "vocab" logical axis so
+the tensor axis shards the prototypes; softmax/sinkhorn downstream handle
+sharded logits as plain global-array math under GSPMD (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.common import part, trunc_normal_init
+
+
+class DINOHead(nn.Module):
+    out_dim: int
+    hidden_dim: int = 2048
+    bottleneck_dim: int = 256
+    nlayers: int = 3
+    mlp_bias: bool = True
+    norm_last_layer: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    reduce_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, skip_last_layer: bool = False,
+                 only_last_layer: bool = False) -> jnp.ndarray:
+        dense = lambda feats, name, names: nn.Dense(  # noqa: E731
+            feats, use_bias=self.mlp_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), names),
+            bias_init=part(nn.initializers.zeros, (names[-1],)),
+            name=name,
+        )
+        if not only_last_layer:
+            n = max(1, self.nlayers)
+            if n == 1:
+                x = dense(self.bottleneck_dim, "mlp_0", ("embed", "mlp"))(x)
+            else:
+                x = dense(self.hidden_dim, "mlp_0", ("embed", "mlp"))(x)
+                x = nn.gelu(x)
+                for i in range(1, n - 1):
+                    x = dense(self.hidden_dim, f"mlp_{i}", ("mlp", "mlp"))(x)
+                    x = nn.gelu(x)
+                x = dense(self.bottleneck_dim, f"mlp_{n-1}", ("mlp", None))(x)
+            # L2 normalize in fp32 (eps as in reference dino_head.py:80-82)
+            xf = x.astype(self.reduce_dtype)
+            norm = jnp.linalg.norm(xf, ord=2, axis=-1, keepdims=True)
+            x = (xf / (norm + 1e-12)).astype(self.dtype)
+        if skip_last_layer:
+            return x
+        prototypes = self.param(
+            "prototypes", part(trunc_normal_init(), (None, "vocab")),
+            (self.bottleneck_dim, self.out_dim), self.param_dtype,
+        )
+        w = prototypes.astype(self.reduce_dtype)
+        if self.norm_last_layer:
+            w = w / (jnp.linalg.norm(w, axis=0, keepdims=True) + 1e-12)
+        return (x.astype(self.reduce_dtype) @ w).astype(self.reduce_dtype)

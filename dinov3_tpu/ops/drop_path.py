@@ -1,0 +1,27 @@
+"""Per-sample stochastic depth.
+
+Replaces the reference's data-dependent batch-subset indexing trick
+(dinov3_jax/layers/block.py:94-117) — which cannot be jitted with static
+shapes on TPU — with the standard per-sample Bernoulli residual mask
+(same expectation, fully static shapes; SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class DropPath(nn.Module):
+    rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        if deterministic or self.rate == 0.0:
+            return x
+        keep = 1.0 - self.rate
+        rng = self.make_rng("drop_path")
+        shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, jnp.zeros_like(x)).astype(x.dtype)

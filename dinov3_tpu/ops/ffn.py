@@ -1,0 +1,95 @@
+"""Feed-forward layers: standard ViT MLP and SwiGLU.
+
+(reference: dinov3_jax/layers/ffn_layers.py. The reference's ``Mlp`` applied
+activation+dropout after the *second* Dense too — a deviation from the
+standard ViT MLP and from Meta's PyTorch DINOv3; we use the standard form,
+SURVEY.md §2.3. SwiGLU hidden sizing matches: ``int(2/3 * hidden)`` rounded
+up to ``align_to``.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.common import part, trunc_normal_init
+
+
+class Mlp(nn.Module):
+    hidden_dim: int
+    out_dim: int | None = None
+    act: Callable = nn.gelu
+    use_bias: bool = True
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        out_dim = self.out_dim or x.shape[-1]
+        x = nn.Dense(
+            self.hidden_dim, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), ("embed", "mlp")),
+            bias_init=part(nn.initializers.zeros, ("mlp",)),
+            name="fc1",
+        )(x)
+        x = self.act(x)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        x = nn.Dense(
+            out_dim, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), ("mlp", "embed")),
+            bias_init=part(nn.initializers.zeros, ("embed",)),
+            name="fc2",
+        )(x)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        return x
+
+
+def swiglu_hidden_dim(hidden_dim: int, align_to: int = 8) -> int:
+    """2/3 rule rounded up to a lane-friendly multiple."""
+    d = int(hidden_dim * 2 / 3)
+    return (d + align_to - 1) // align_to * align_to
+
+
+class SwiGLUFFN(nn.Module):
+    hidden_dim: int
+    out_dim: int | None = None
+    use_bias: bool = True
+    align_to: int = 64  # keep the hidden dim MXU/lane aligned on TPU
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        out_dim = self.out_dim or x.shape[-1]
+        d = swiglu_hidden_dim(self.hidden_dim, self.align_to)
+        # fused [gate | value] projection: one big MXU matmul
+        w12 = nn.Dense(
+            2 * d, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), ("embed", "mlp")),
+            bias_init=part(nn.initializers.zeros, ("mlp",)),
+            name="w12",
+        )(x)
+        gate, value = jnp.split(w12, 2, axis=-1)
+        x = nn.silu(gate) * value
+        return nn.Dense(
+            out_dim, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), ("mlp", "embed")),
+            bias_init=part(nn.initializers.zeros, ("embed",)),
+            name="w3",
+        )(x)
+
+
+def make_ffn_layer(kind: str, hidden_dim: int, **kwargs) -> nn.Module:
+    if kind == "mlp":
+        return Mlp(hidden_dim=hidden_dim, **kwargs)
+    if kind in ("swiglu", "swiglu64", "swiglu128"):
+        align = {"swiglu": 8, "swiglu64": 64, "swiglu128": 128}[kind]
+        return SwiGLUFFN(hidden_dim=hidden_dim, align_to=align, **kwargs)
+    raise ValueError(f"unknown ffn layer {kind!r}")

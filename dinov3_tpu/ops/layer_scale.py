@@ -1,0 +1,28 @@
+"""LayerScale: learned per-channel residual scaling.
+
+(reference: dinov3_jax/layers/layer_scale.py)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.common import part
+
+
+class LayerScale(nn.Module):
+    init_value: float = 1e-5
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        gamma = self.param(
+            "gamma",
+            part(nn.initializers.constant(self.init_value), ("embed",)),
+            (x.shape[-1],),
+            self.param_dtype,
+        )
+        return x * gamma.astype(x.dtype)

@@ -1,0 +1,64 @@
+"""Normalization layers with fp32 statistic accumulation.
+
+(reference: dinov3_jax/layers/rms_norm.py — which accumulated in fp32 but had
+a ``jnp.float`` typo; and plain ``nn.LayerNorm`` used throughout the ViT.)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.common import part
+
+
+class LayerNorm(nn.Module):
+    """LayerNorm: fp32 stats, params in param_dtype, output in input dtype."""
+
+    epsilon: float = 1e-6
+    param_dtype: Any = jnp.float32
+    reduce_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        dim = x.shape[-1]
+        scale = self.param("scale", part(nn.initializers.ones, ("embed",)), (dim,),
+                           self.param_dtype)
+        bias = self.param("bias", part(nn.initializers.zeros, ("embed",)), (dim,),
+                          self.param_dtype)
+        xf = x.astype(self.reduce_dtype)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = y * scale.astype(self.reduce_dtype) + bias.astype(self.reduce_dtype)
+        return y.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    """RMSNorm: fp32 mean-square, learned scale."""
+
+    epsilon: float = 1e-6
+    param_dtype: Any = jnp.float32
+    reduce_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        dim = x.shape[-1]
+        scale = self.param("scale", part(nn.initializers.ones, ("embed",)), (dim,),
+                           self.param_dtype)
+        xf = x.astype(self.reduce_dtype)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(ms + self.epsilon)
+        y = y * scale.astype(self.reduce_dtype)
+        return y.astype(x.dtype)
+
+
+def make_norm_layer(kind: str, **kwargs) -> nn.Module:
+    if kind in ("layernorm", "layer_norm", "ln"):
+        return LayerNorm(**kwargs)
+    if kind in ("rmsnorm", "rms_norm", "rms"):
+        return RMSNorm(**kwargs)
+    raise ValueError(f"unknown norm layer {kind!r}")

@@ -1,0 +1,52 @@
+"""Patch embedding as an explicit unfold + matmul.
+
+The reference used a strided conv (dinov3_jax/layers/patch_embed.py:38-42).
+On TPU a stride==kernel "conv" is exactly a reshape + one large [B*T, p*p*C]
+x [p*p*C, D] matmul, which maps straight onto the MXU with no conv layout
+heuristics; the weight is kept in conv layout [p, p, C, D] so torch/reference
+checkpoints port unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.common import part, trunc_normal_init
+
+
+class PatchEmbed(nn.Module):
+    embed_dim: int
+    patch_size: int = 16
+    in_chans: int = 3
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        """[B, H, W, C] (NHWC) -> [B, H/p * W/p, D]."""
+        B, H, W, C = x.shape
+        p = self.patch_size
+        if H % p or W % p:
+            raise ValueError(f"image size {(H, W)} not divisible by patch {p}")
+        kernel = self.param(
+            "kernel",
+            part(trunc_normal_init(), (None, None, None, "embed")),
+            (p, p, C, self.embed_dim),
+            self.param_dtype,
+        )
+        h, w = H // p, W // p
+        x = x.reshape(B, h, p, w, p, C).transpose(0, 1, 3, 2, 4, 5)
+        x = x.reshape(B, h * w, p * p * C).astype(self.dtype)
+        w_mat = kernel.reshape(p * p * C, self.embed_dim).astype(self.dtype)
+        y = x @ w_mat
+        if self.use_bias:
+            bias = self.param(
+                "bias", part(nn.initializers.zeros, ("embed",)),
+                (self.embed_dim,), self.param_dtype,
+            )
+            y = y + bias.astype(self.dtype)
+        return y

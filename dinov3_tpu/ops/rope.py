@@ -1,0 +1,160 @@
+"""2-D rotary position embeddings for ViT patch grids, as pure functions.
+
+Math parity with the reference module (dinov3_jax/layers/rope_position_encoding.py):
+- period spectrum from ``base ** (2j / (D_head/2))`` for j in [0, D_head/4)
+  or geometric between ``min_period`` and ``max_period``;
+- patch-center coordinates normalized to [-1, 1] per the ``min|max|separate``
+  mode (the reference's "min" mode used max(H, W) — a bug we fix, SURVEY.md
+  §2.9.8);
+- optional train-time coordinate augmentation: global shift, per-axis
+  log-uniform jitter, isotropic log-uniform rescale;
+- output ``(sin, cos)`` of shape [H*W, D_head] consumed by ``rope_apply``
+  with rotate-half pairing.
+
+Pure functions (not a Flax module): the tables depend only on static config
++ (H, W) + an rng, so the ViT computes one table per crop resolution per
+step and passes it to all blocks — no per-block recompute as in the
+reference (dinov3_jax/models/vision_transformer.py:212-217).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_periods(
+    head_dim: int,
+    base: float | None = 100.0,
+    min_period: float | None = None,
+    max_period: float | None = None,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """[head_dim // 4] period spectrum."""
+    if head_dim % 4 != 0:
+        raise ValueError(f"head_dim must be divisible by 4, got {head_dim}")
+    both = min_period is not None and max_period is not None
+    if (base is None) == (not both):
+        raise ValueError("provide either `base` or `min_period`+`max_period`")
+    n = head_dim // 4
+    if base is not None:
+        return jnp.asarray(base, dtype) ** (
+            2.0 * jnp.arange(n, dtype=dtype) / (head_dim / 2.0)
+        )
+    ratio = max_period / min_period
+    exponents = jnp.linspace(0.0, 1.0, n, dtype=dtype)
+    return (ratio**exponents) * (max_period / ratio)
+
+
+def patch_coords(
+    H: int,
+    W: int,
+    normalize: Literal["min", "max", "separate"] = "separate",
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """[H*W, 2] patch-center coordinates in [-1, 1] (row-major, ij order)."""
+    if normalize == "max":
+        denom_h = denom_w = max(H, W)
+    elif normalize == "min":
+        denom_h = denom_w = min(H, W)
+    elif normalize == "separate":
+        denom_h, denom_w = H, W
+    else:
+        raise ValueError(f"unknown normalize mode {normalize!r}")
+    ch = (jnp.arange(H, dtype=dtype) + 0.5) / denom_h
+    cw = (jnp.arange(W, dtype=dtype) + 0.5) / denom_w
+    coords = jnp.stack(jnp.meshgrid(ch, cw, indexing="ij"), axis=-1).reshape(-1, 2)
+    return 2.0 * coords - 1.0
+
+
+def augment_coords(
+    coords: jnp.ndarray,
+    rng: jax.Array,
+    shift: float | None = None,
+    jitter: float | None = None,
+    rescale: float | None = None,
+) -> jnp.ndarray:
+    """Train-time coordinate augmentation (jittable; factors of 1 when off)."""
+    rng_shift, rng_jitter, rng_rescale = jax.random.split(rng, 3)
+    d = coords.dtype
+    if shift is not None:
+        coords = coords + jax.random.uniform(
+            rng_shift, (2,), minval=-shift, maxval=shift, dtype=d
+        )
+    if jitter is not None:
+        j = math.log(jitter)
+        coords = coords * jnp.exp(
+            jax.random.uniform(rng_jitter, (2,), minval=-j, maxval=j, dtype=d)
+        )
+    if rescale is not None:
+        r = math.log(rescale)
+        coords = coords * jnp.exp(
+            jax.random.uniform(rng_rescale, (1,), minval=-r, maxval=r, dtype=d)
+        )
+    return coords
+
+
+def rope_sincos(
+    H: int,
+    W: int,
+    periods: jnp.ndarray,
+    normalize: Literal["min", "max", "separate"] = "separate",
+    rng: jax.Array | None = None,
+    shift: float | None = None,
+    jitter: float | None = None,
+    rescale: float | None = None,
+    dtype=jnp.float32,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(sin, cos), each [H*W, 4*len(periods)] == [H*W, head_dim]."""
+    coords = patch_coords(H, W, normalize, dtype=jnp.float32)
+    if rng is not None and (shift or jitter or rescale):
+        coords = augment_coords(coords, rng, shift, jitter, rescale)
+    # [HW, 2, 1] / [P] -> [HW, 2, P] -> [HW, 2P] -> duplicated rotate-half halves
+    angles = 2.0 * math.pi * coords[:, :, None] / periods[None, None, :]
+    angles = angles.reshape(angles.shape[0], -1)
+    angles = jnp.concatenate([angles, angles], axis=-1)
+    return jnp.sin(angles).astype(dtype), jnp.cos(angles).astype(dtype)
+
+
+def rope_rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def rope_apply(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray) -> jnp.ndarray:
+    """Rotate the trailing head_dim of x ([..., N, head_dim]) by the table."""
+    return x * cos + rope_rotate_half(x) * sin
+
+
+def rope_apply_with_prefix(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    sin: jnp.ndarray,
+    cos: jnp.ndarray,
+    dtype=None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply RoPE to the trailing-patch part of q/k, skipping prefix tokens.
+
+    q, k: [B, N, heads, head_dim]; sin/cos: [P, head_dim] with P <= N.
+    The first N - P tokens (CLS + storage/register tokens) pass through
+    unrotated (reference: dinov3_jax/layers/attention.py:77-87).
+    """
+    n_prefix = q.shape[-3] - sin.shape[-2]
+    if n_prefix < 0:
+        raise ValueError(
+            f"rope table covers {sin.shape[-2]} tokens but sequence has {q.shape[-3]}"
+        )
+    compute = dtype or q.dtype
+    sin = sin[:, None, :].astype(compute)  # [P, 1, head_dim] broadcasting over heads
+    cos = cos[:, None, :].astype(compute)
+
+    def rot(t):
+        patch = rope_apply(t[..., n_prefix:, :, :].astype(compute), sin, cos)
+        return jnp.concatenate(
+            [t[..., :n_prefix, :, :], patch.astype(t.dtype)], axis=-3
+        )
+
+    return rot(q), rot(k)

@@ -3,7 +3,9 @@ runs a real multi-device mesh without hardware (SURVEY.md §4 implication (a))."
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-override: the ambient env may pin JAX_PLATFORMS=axon (the tunneled
+# TPU); the test suite always runs on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,6 +14,11 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# jax may already be imported by pytest plugins (jaxtyping/typeguard), in
+# which case the env vars above were captured too late — but the backend is
+# not initialized until first use, so config updates still take effect.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402

@@ -1,0 +1,246 @@
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinov3_tpu.ops import (
+    DINOHead,
+    LayerNorm,
+    LayerScale,
+    Mlp,
+    PatchEmbed,
+    RMSNorm,
+    SelfAttention,
+    SelfAttentionBlock,
+    SwiGLUFFN,
+    rope_apply_with_prefix,
+    rope_periods,
+    rope_sincos,
+    swiglu_hidden_dim,
+    xla_attention,
+)
+
+F32 = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+# ---------------- RoPE ----------------
+
+def test_rope_periods_base_spectrum():
+    p = rope_periods(head_dim=16, base=100.0)
+    assert p.shape == (4,)
+    # base ** (2j / (D/2)) for j in 0..3, D=16
+    expect = 100.0 ** (2 * np.arange(4) / 8.0)
+    np.testing.assert_allclose(np.asarray(p), expect, rtol=1e-5)
+
+
+def test_rope_periods_minmax_range():
+    p = np.asarray(rope_periods(head_dim=16, base=None, min_period=0.5, max_period=8.0))
+    assert abs(p[0] - 0.5) < 1e-5 and abs(p[-1] - 8.0) < 1e-4
+    assert np.all(np.diff(p) > 0)
+
+
+def test_rope_sincos_shapes_and_identity():
+    sin, cos = rope_sincos(4, 6, rope_periods(32))
+    assert sin.shape == (24, 32) and cos.shape == (24, 32)
+    np.testing.assert_allclose(np.asarray(sin**2 + cos**2), 1.0, atol=1e-5)
+
+
+def test_rope_rotation_preserves_norm_and_prefix():
+    rng = jax.random.key(0)
+    B, N, h, d, P = 2, 10, 3, 16, 8
+    q = jax.random.normal(rng, (B, N, h, d))
+    k = jax.random.normal(jax.random.key(1), (B, N, h, d))
+    sin, cos = rope_sincos(2, 4, rope_periods(d))
+    q2, k2 = rope_apply_with_prefix(q, k, sin, cos)
+    # prefix tokens (first N-P) untouched
+    np.testing.assert_allclose(np.asarray(q2[:, : N - P]), np.asarray(q[:, : N - P]))
+    # rotation preserves per-pair norms => full vector norm
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(q2), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1),
+        rtol=1e-5,
+    )
+    # and actually rotates
+    assert not np.allclose(np.asarray(q2[:, -1]), np.asarray(q[:, -1]))
+
+
+def test_rope_augmentation_changes_tables():
+    p = rope_periods(16)
+    s1, _ = rope_sincos(4, 4, p, rng=jax.random.key(0), shift=0.5)
+    s2, _ = rope_sincos(4, 4, p, rng=jax.random.key(1), shift=0.5)
+    s3, _ = rope_sincos(4, 4, p)
+    assert not np.allclose(np.asarray(s1), np.asarray(s2))
+    assert not np.allclose(np.asarray(s1), np.asarray(s3))
+
+
+# ---------------- attention ----------------
+
+def test_xla_attention_matches_flax():
+    rng = jax.random.key(0)
+    B, N, h, d = 2, 9, 4, 8
+    q, k, v = jax.random.normal(rng, (3, B, N, h, d))
+    ours = xla_attention(q, k, v)
+    ref = nn.dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+
+def test_self_attention_forward_and_k_bias_invariance():
+    B, N, D = 2, 12, 32
+    x = jax.random.normal(jax.random.key(0), (B, N, D))
+    attn = SelfAttention(dim=D, num_heads=4, mask_k_bias=True, attn_impl="xla", **F32)
+    params = nn.meta.unbox(attn.init(jax.random.key(1), x))
+    y0 = attn.apply(params, x)
+    assert y0.shape == (B, N, D)
+    # poke the k third of the qkv bias: masked -> output must not change
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(params)
+    key = [k_ for k_ in flat if k_[-1] == "qkv_bias"][0]
+    b = flat[key]
+    poked = b.at[D : 2 * D].set(77.0)
+    flat[key] = poked
+    params2 = flax.traverse_util.unflatten_dict(flat)
+    y1 = attn.apply(params2, x)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), atol=1e-6)
+    # q third is NOT masked
+    flat[key] = b.at[:D].set(7.0)
+    y2 = attn.apply(flax.traverse_util.unflatten_dict(flat), x)
+    assert not np.allclose(np.asarray(y0), np.asarray(y2))
+
+
+def test_self_attention_with_rope_runs():
+    B, N, D, h = 2, 4 + 2, 32, 4  # 2 prefix + 2x2 patches
+    x = jax.random.normal(jax.random.key(0), (B, N, D))
+    rope = rope_sincos(2, 2, rope_periods(D // h))
+    attn = SelfAttention(dim=D, num_heads=h, attn_impl="xla", **F32)
+    params = attn.init(jax.random.key(1), x, rope=rope)
+    y = attn.apply(params, x, rope=rope)
+    assert y.shape == (B, N, D)
+
+
+# ---------------- ffn / norms / misc ----------------
+
+def test_swiglu_hidden_rule():
+    assert swiglu_hidden_dim(4096, 64) == 2752  # ceil(2731/64)*64
+    assert swiglu_hidden_dim(12, 8) == 8
+
+
+def test_mlp_and_swiglu_shapes():
+    x = jax.random.normal(jax.random.key(0), (2, 5, 24))
+    mlp = Mlp(hidden_dim=96, **F32)
+    p = mlp.init(jax.random.key(1), x)
+    assert mlp.apply(p, x).shape == x.shape
+    sw = SwiGLUFFN(hidden_dim=96, align_to=8, **F32)
+    p = sw.init(jax.random.key(1), x)
+    assert sw.apply(p, x).shape == x.shape
+
+
+def test_layernorm_matches_flax():
+    x = jax.random.normal(jax.random.key(0), (4, 7, 16))
+    ours = LayerNorm()
+    p = ours.init(jax.random.key(1), x)
+    ref = nn.LayerNorm(epsilon=1e-6)
+    pr = ref.init(jax.random.key(1), x)
+    np.testing.assert_allclose(
+        np.asarray(ours.apply(p, x)), np.asarray(ref.apply(pr, x)), atol=1e-5
+    )
+
+
+def test_rmsnorm_formula():
+    x = jax.random.normal(jax.random.key(0), (3, 8))
+    m = RMSNorm(epsilon=1e-6)
+    p = m.init(jax.random.key(1), x)
+    got = np.asarray(m.apply(p, x))
+    xn = np.asarray(x)
+    expect = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(got, expect, atol=1e-5)
+
+
+def test_patch_embed_matches_conv():
+    B, H, W, C, D, ps = 2, 8, 8, 3, 16, 4
+    x = jax.random.normal(jax.random.key(0), (B, H, W, C))
+    pe = PatchEmbed(embed_dim=D, patch_size=ps, **F32)
+    params = nn.meta.unbox(pe.init(jax.random.key(1), x))
+    y = pe.apply(params, x)
+    assert y.shape == (B, 4, D)
+    kernel = params["params"]["kernel"]
+    bias = params["params"]["bias"]
+    ref = jax.lax.conv_general_dilated(
+        x, kernel, window_strides=(ps, ps), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ).reshape(B, 4, D) + bias
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-4)
+
+
+def test_dino_head_bottleneck_unit_norm_and_shapes():
+    x = jax.random.normal(jax.random.key(0), (6, 32))
+    head = DINOHead(out_dim=64, hidden_dim=48, bottleneck_dim=16, **F32)
+    p = head.init(jax.random.key(1), x)
+    z = head.apply(p, x, skip_last_layer=True)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(z), axis=-1), 1.0, atol=1e-4)
+    logits = head.apply(p, x)
+    assert logits.shape == (6, 64)
+    # only_last_layer consumes bottleneck input
+    out = head.apply(p, z, only_last_layer=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(logits), atol=1e-5)
+
+
+def test_dino_head_weight_norm():
+    x = jax.random.normal(jax.random.key(0), (4, 32))
+    head = DINOHead(out_dim=16, hidden_dim=48, bottleneck_dim=8,
+                    norm_last_layer=True, **F32)
+    p = head.init(jax.random.key(1), x)
+    z = head.apply(p, x, skip_last_layer=True)
+    logits = head.apply(p, z, only_last_layer=True)
+    # |logit_k| <= |z| * |w_k| = 1 (both unit-norm) by Cauchy-Schwarz
+    assert np.abs(np.asarray(logits)).max() <= 1.0 + 1e-5
+
+
+def test_layer_scale_init_value():
+    x = jnp.ones((2, 3, 8))
+    m = LayerScale(init_value=1e-5)
+    p = m.init(jax.random.key(0), x)
+    np.testing.assert_allclose(np.asarray(m.apply(p, x)), 1e-5, rtol=1e-6)
+
+
+# ---------------- block ----------------
+
+def test_block_forward_and_drop_path():
+    B, N, D = 4, 6, 32
+    x = jax.random.normal(jax.random.key(0), (B, N, D))
+    blk = SelfAttentionBlock(dim=D, num_heads=4, drop_path_rate=0.5,
+                             attn_impl="xla", **F32)
+    params = blk.init(jax.random.key(1), x)
+    y = blk.apply(params, x)  # deterministic: no drop_path rng needed
+    assert y.shape == x.shape
+    # train mode: per-sample drop — outputs differ across rng
+    y1 = blk.apply(params, x, deterministic=False,
+                   rngs={"drop_path": jax.random.key(2)})
+    y2 = blk.apply(params, x, deterministic=False,
+                   rngs={"drop_path": jax.random.key(3)})
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_block_swiglu_rmsnorm_variant():
+    B, N, D = 2, 6, 32
+    x = jax.random.normal(jax.random.key(0), (B, N, D))
+    blk = SelfAttentionBlock(dim=D, num_heads=4, ffn_layer="swiglu",
+                             norm_layer="rmsnorm", attn_impl="xla", **F32)
+    params = blk.init(jax.random.key(1), x)
+    assert blk.apply(params, x).shape == x.shape
+
+
+def test_block_grads_flow():
+    B, N, D = 2, 6, 32
+    x = jax.random.normal(jax.random.key(0), (B, N, D))
+    blk = SelfAttentionBlock(dim=D, num_heads=4, attn_impl="xla", **F32)
+    params = blk.init(jax.random.key(1), x)
+
+    def loss(p):
+        return jnp.sum(blk.apply(p, x) ** 2)
+
+    g = jax.grad(loss)(params)
+    leaves = jax.tree.leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    assert any(np.abs(np.asarray(l)).max() > 0 for l in leaves)

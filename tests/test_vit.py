@@ -1,0 +1,150 @@
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinov3_tpu.configs import get_default_config, apply_dot_overrides
+from dinov3_tpu.models import build_backbone, build_model_from_cfg
+from dinov3_tpu.models.vision_transformer import DinoVisionTransformer, vit_test
+
+F32 = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+TINY = dict(embed_dim=32, n_blocks=2, num_heads=2, ffn_ratio=2.0,
+            patch_size=4, attn_impl="xla", **F32)
+
+
+def tiny(**kw):
+    return DinoVisionTransformer(**{**TINY, **kw})
+
+
+def test_forward_features_shapes():
+    m = tiny(n_storage_tokens=3, layerscale_init=1e-5)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 16, 3))
+    params = m.init(jax.random.key(1), x)
+    out = m.apply(params, x)
+    assert out["x_norm_clstoken"].shape == (2, 32)
+    assert out["x_storage_tokens"].shape == (2, 3, 32)
+    assert out["x_norm_patchtokens"].shape == (2, 16, 32)
+    assert out["x_prenorm"].shape == (2, 1 + 3 + 16, 32)
+
+
+def test_mask_tokens_change_output():
+    m = tiny()
+    x = jax.random.normal(jax.random.key(0), (2, 16, 16, 3))
+    params = m.init(jax.random.key(1), x)
+    masks = jnp.zeros((2, 16), bool).at[:, :8].set(True)
+    out_masked = m.apply(params, x, masks)
+    out_plain = m.apply(params, x)
+    assert not np.allclose(
+        np.asarray(out_masked["x_norm_patchtokens"]),
+        np.asarray(out_plain["x_norm_patchtokens"]),
+    )
+
+
+def test_resolution_agnostic_rope():
+    """Same params must run any crop resolution (multi-crop requirement)."""
+    m = tiny()
+    x224 = jax.random.normal(jax.random.key(0), (1, 16, 16, 3))
+    x96 = jax.random.normal(jax.random.key(1), (1, 8, 8, 3))
+    params = m.init(jax.random.key(2), x224)
+    out_g = m.apply(params, x224)
+    out_l = m.apply(params, x96)
+    assert out_g["x_norm_patchtokens"].shape == (1, 16, 32)
+    assert out_l["x_norm_patchtokens"].shape == (1, 4, 32)
+
+
+def test_untied_norms_used_for_local_crops():
+    m = tiny(untie_cls_and_patch_norms=True, untie_global_and_local_cls_norm=True)
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 3))
+    params = nn.meta.unbox(m.init(jax.random.key(1), x))
+    p = params["params"]
+    assert "cls_norm" in p and "local_cls_norm" in p and "norm" in p
+    # make local_cls_norm distinguishable
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(params)
+    flat[("params", "local_cls_norm", "scale")] = (
+        flat[("params", "local_cls_norm", "scale")] * 5.0
+    )
+    params2 = flax.traverse_util.unflatten_dict(flat)
+    out_global = m.apply(params2, x, crop_kind="global", deterministic=False,
+                         rngs={"drop_path": jax.random.key(2)})
+    out_local = m.apply(params2, x, crop_kind="local", deterministic=False,
+                        rngs={"drop_path": jax.random.key(2)})
+    assert not np.allclose(np.asarray(out_global["x_norm_clstoken"]),
+                           np.asarray(out_local["x_norm_clstoken"]))
+    # patch tokens share the patch norm either way
+    np.testing.assert_allclose(np.asarray(out_global["x_norm_patchtokens"]),
+                               np.asarray(out_local["x_norm_patchtokens"]),
+                               atol=1e-6)
+
+
+def test_scan_layers_matches_loop():
+    """Scanned stack must compute the same function family (same shapes,
+    deterministic forward) as the unrolled loop given transplanted params."""
+    m_loop = tiny(n_blocks=3)
+    m_scan = tiny(n_blocks=3, scan_layers=True)
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 3))
+    p_loop = nn.meta.unbox(m_loop.init(jax.random.key(1), x))
+    p_scan = nn.meta.unbox(m_scan.init(jax.random.key(1), x))
+    import flax
+
+    flat_loop = flax.traverse_util.flatten_dict(p_loop["params"])
+    flat_scan = flax.traverse_util.flatten_dict(p_scan["params"])
+    # transplant loop params into the scan stack (stack blocks_i leaves)
+    stacked = {}
+    for k, v in flat_scan.items():
+        if k[0] == "blocks":
+            # scan tree: ("blocks", "block", ...); loop tree: (f"blocks_{i}", ...)
+            per_layer = [
+                flat_loop[(f"blocks_{i}",) + k[2:]] for i in range(3)
+            ]
+            stacked[k] = jnp.stack(per_layer, axis=0)
+        else:
+            stacked[k] = flat_loop[k]
+        assert stacked[k].shape == v.shape, (k, stacked[k].shape, v.shape)
+    p_scan2 = {"params": flax.traverse_util.unflatten_dict(stacked)}
+    out_loop = m_loop.apply(p_loop, x)
+    out_scan = m_scan.apply(p_scan2, x)
+    np.testing.assert_allclose(
+        np.asarray(out_loop["x_norm_clstoken"]),
+        np.asarray(out_scan["x_norm_clstoken"]),
+        atol=1e-5,
+    )
+
+
+def test_get_intermediate_layers():
+    m = tiny(n_blocks=3)
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 3))
+    params = m.init(jax.random.key(1), x)
+    outs = m.apply(params, x, n=2, reshape=True,
+                   return_class_token=True,
+                   method=DinoVisionTransformer.get_intermediate_layers)
+    assert len(outs) == 2
+    patches, cls = outs[0]
+    assert patches.shape == (2, 32, 2, 2)
+    assert cls.shape == (2, 32)
+
+
+def test_build_from_cfg():
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, ["student.arch=vit_test", "student.patch_size=4",
+                              "student.drop_path_rate=0.2"])
+    student, teacher, dim = build_model_from_cfg(cfg)
+    assert dim == 64
+    assert student.drop_path_rate == 0.2
+    assert teacher.drop_path_rate == 0.0  # teacher never drops paths
+    assert teacher.pos_embed_rope_jitter_coords is None
+
+
+def test_arch_ladder_dims():
+    from dinov3_tpu.models import vit_7b, vit_giant2, vit_large, vit_so400m
+
+    l = vit_large()
+    assert (l.embed_dim, l.n_blocks, l.num_heads) == (1024, 24, 16)
+    g = vit_giant2()
+    assert (g.embed_dim, g.n_blocks, g.num_heads) == (1536, 40, 24)
+    b7 = vit_7b()
+    assert (b7.embed_dim, b7.n_blocks, b7.num_heads, b7.ffn_ratio) == (4096, 40, 32, 3.0)
+    so = vit_so400m()
+    assert (so.embed_dim, so.n_blocks, so.num_heads) == (1152, 27, 18)
