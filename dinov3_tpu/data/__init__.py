@@ -1,0 +1,11 @@
+from dinov3_tpu.data.masking import block_mask, sample_ibot_masks
+from dinov3_tpu.data.synthetic import (
+    SyntheticDataset,
+    batch_spec,
+    make_synthetic_batch,
+)
+
+__all__ = [
+    "block_mask", "sample_ibot_masks", "SyntheticDataset", "batch_spec",
+    "make_synthetic_batch",
+]

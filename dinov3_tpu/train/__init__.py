@@ -1,0 +1,18 @@
+from dinov3_tpu.train.optimizer import (
+    build_optimizer,
+    clip_by_per_submodel_norm,
+    scheduled_adamw,
+)
+from dinov3_tpu.train.param_groups import build_multiplier_trees
+from dinov3_tpu.train.schedules import (
+    Schedules,
+    build_schedules,
+    cosine_schedule,
+    linear_warmup_cosine_decay,
+)
+
+__all__ = [
+    "build_optimizer", "clip_by_per_submodel_norm", "scheduled_adamw",
+    "build_multiplier_trees", "Schedules", "build_schedules",
+    "cosine_schedule", "linear_warmup_cosine_decay",
+]

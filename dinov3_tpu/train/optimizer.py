@@ -1,0 +1,107 @@
+"""AdamW with in-graph schedules and per-leaf multiplier trees.
+
+One optax chain replaces the reference's dict of per-group
+``optax.inject_hyperparams(optax.adamw)`` under ``multi_transform``
+(reference: dinov3_jax/train/train.py:75-122), fixing its late-binding
+closure bug (every group got the last group's multipliers, SURVEY.md
+§2.9.4). Schedules live on device as constant arrays indexed by the optax
+step counter, so the whole update is a single jitted program with no
+per-step host->device hyperparameter uploads.
+
+Update rule per leaf (matching optax.adamw semantics):
+    u = -lr_t * lr_mult * (adam_dir + wd_t * wd_mult * param)
+with lr_t taken from ``last_layer_lr`` for prototype layers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dinov3_tpu.train.param_groups import build_multiplier_trees
+from dinov3_tpu.train.schedules import Schedules
+
+
+class ScheduledAdamWState(NamedTuple):
+    count: jnp.ndarray
+    adam: optax.OptState
+
+
+def scheduled_adamw(
+    schedules: Schedules,
+    lr_mult: Any,
+    wd_mult: Any,
+    is_last_layer: Any,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    lr_arr = jnp.asarray(schedules.lr, jnp.float32)
+    ll_lr_arr = jnp.asarray(schedules.last_layer_lr, jnp.float32)
+    wd_arr = jnp.asarray(schedules.weight_decay, jnp.float32)
+    adam = optax.scale_by_adam(b1=b1, b2=b2, eps=eps)
+
+    def init_fn(params):
+        return ScheduledAdamWState(
+            count=jnp.zeros((), jnp.int32), adam=adam.init(params)
+        )
+
+    def update_fn(updates, state, params):
+        if params is None:
+            raise ValueError("scheduled_adamw requires params for weight decay")
+        adam_dir, adam_state = adam.update(updates, state.adam, params)
+        i = jnp.minimum(state.count, lr_arr.shape[0] - 1)
+        lr_t, ll_lr_t, wd_t = lr_arr[i], ll_lr_arr[i], wd_arr[i]
+
+        def leaf_update(direction, param, lm, wm, is_ll):
+            lr = jnp.where(is_ll, ll_lr_t, lr_t)
+            d = direction + wd_t * wm * param.astype(direction.dtype)
+            return -lr * lm * d
+
+        new_updates = jax.tree.map(
+            leaf_update, adam_dir, params, lr_mult, wd_mult, is_last_layer
+        )
+        return new_updates, ScheduledAdamWState(state.count + 1, adam_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def build_optimizer(
+    cfg, params: Any, schedules: Schedules
+) -> optax.GradientTransformation:
+    """Wire config -> multiplier trees -> scheduled adamw.
+
+    ``params``: the *student* parameter pytree (unboxed), used only for path
+    structure.
+    """
+    lr_mult, wd_mult, is_last = build_multiplier_trees(
+        params,
+        layerwise_decay=cfg.optim.layerwise_decay,
+        patch_embed_lr_mult=cfg.optim.patch_embed_lr_mult,
+        dino_head_wd_multiplier=cfg.optim.dino_head_wd_multiplier,
+    )
+    if cfg.optim.optimizer != "adamw":
+        raise ValueError(f"unsupported optimizer {cfg.optim.optimizer!r}")
+    return scheduled_adamw(
+        schedules, lr_mult, wd_mult, is_last,
+        b1=cfg.optim.adamw_beta1, b2=cfg.optim.adamw_beta2,
+    )
+
+
+def clip_by_per_submodel_norm(grads: Any, max_norm: float) -> tuple[Any, Any]:
+    """Global-norm clip applied independently per top-level submodule
+    (backbone / dino_head / ibot_head), as the reference does in-step
+    (reference: train/train.py:524-541). Returns (clipped, norms_dict)."""
+    clipped = {}
+    norms = {}
+    for key, sub in grads.items():
+        leaves = jax.tree.leaves(sub)
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                            for l in leaves))
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        clipped[key] = jax.tree.map(lambda l: (l * scale).astype(l.dtype), sub)
+        norms[key] = norm
+    return clipped, norms

@@ -1,0 +1,420 @@
+"""DINOv3 SSL meta-architecture, functional style.
+
+(reference: dinov3_jax/train/ssl_meta_arch.py — a Flax module holding
+student/teacher/gram backbones + heads whose params lived in one variable
+tree wrapped by the FSDP interceptor. Redesigned:
+
+- ``SSLMetaArch`` is a plain Python object holding *module definitions* and
+  config; parameters are an explicit pytree
+  ``{"student": {backbone, dino_head, ibot_head}, "teacher": {...},
+  ["gram": {...}]}`` threaded through pure functions — the natural shape for
+  GSPMD sharding, donation, and a fused teacher-EMA update (the reference's
+  EMA never fed back into the teacher used by the forward, SURVEY.md §2.9.1);
+- the masked-token buffer is per-image fixed-capacity
+  ([2B, M_img] indices into each image's own tokens, gathered with
+  ``take_along_axis``) instead of the reference's global flat
+  ``mask_indices_list`` — every gather stays local to the batch shard under
+  GSPMD, and shapes are TPU-static (SURVEY.md §7.3);
+- teacher forward runs under ``stop_gradient`` on params the loss never
+  differentiates, no separate "ema module" copies.)
+
+Batch contract (produced by dinov3_tpu/data/collate.py):
+    global_crops [2B, S, S, 3], local_crops [n_l*B, s, s, 3],
+    masks [2B, T] bool, mask_indices [2B, M] int32 (per-image token index,
+    0-padded), mask_weights [2B, M] f32 (1/n_masked(img), 0 for padding),
+    mask_valid [2B, M] bool.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dinov3_tpu.configs import ConfigNode
+from dinov3_tpu.losses import (
+    dino_loss,
+    gram_loss,
+    koleo_loss,
+    sinkhorn_knopp,
+    softmax_center_teacher,
+    update_center,
+)
+from dinov3_tpu.models import build_backbone
+from dinov3_tpu.ops import DINOHead, Policy
+
+
+class SSLMetaArch:
+    def __init__(self, cfg: ConfigNode):
+        if cfg.crops.local_crops_number <= 0:
+            raise ValueError("DINOv3 needs local crops (crops.local_crops_number > 0)")
+        if not cfg.ibot.separate_head:
+            raise ValueError("only ibot.separate_head=true is supported")
+        lo, hi = cfg.ibot.mask_ratio_min_max
+        if not (0 <= lo < hi <= 1):
+            raise ValueError("provide a valid ibot.mask_ratio_min_max")
+        self.cfg = cfg
+        self.policy = Policy.from_cfg(cfg.compute_precision)
+        self.student_backbone = build_backbone(cfg, teacher=False)
+        self.teacher_backbone = build_backbone(cfg, teacher=True)
+        self.embed_dim = self.student_backbone.embed_dim
+
+        head_kw = dict(
+            dtype=self.policy.compute_dtype,
+            param_dtype=self.policy.param_dtype,
+            reduce_dtype=self.policy.reduce_dtype,
+        )
+        self.dino_head = DINOHead(
+            out_dim=cfg.dino.head_n_prototypes,
+            hidden_dim=cfg.dino.head_hidden_dim,
+            bottleneck_dim=cfg.dino.head_bottleneck_dim,
+            nlayers=cfg.dino.head_nlayers,
+            norm_last_layer=cfg.dino.head_norm_last_layer,
+            **head_kw,
+        )
+        self.ibot_head = DINOHead(
+            out_dim=cfg.ibot.head_n_prototypes,
+            hidden_dim=cfg.ibot.head_hidden_dim,
+            bottleneck_dim=cfg.ibot.head_bottleneck_dim,
+            nlayers=cfg.ibot.head_nlayers,
+            norm_last_layer=cfg.ibot.head_norm_last_layer,
+            **head_kw,
+        )
+        self.n_local_crops = cfg.crops.local_crops_number
+        self.centering = cfg.train.centering
+        self.gram_enabled = bool(cfg.gram.use_loss)
+        self.gram_uses_ema_teacher = bool(cfg.gram.ema_teacher)
+        # per-iteration loss-weight ramps (host numpy; moved in-graph by the
+        # train step as constants)
+        self.dino_local_weight_schedule = None
+        if cfg.dino.reweight_dino_local_loss:
+            from dinov3_tpu.train.schedules import linear_warmup_cosine_decay
+
+            s = cfg.dino.local_loss_weight_schedule
+            L = cfg.train.OFFICIAL_EPOCH_LENGTH
+            self.dino_local_weight_schedule = linear_warmup_cosine_decay(
+                start=s["start"], peak=s["peak"], end=s["end"],
+                warmup_iterations=int(s.get("warmup_epochs", 0) * L),
+                total_iterations=L * cfg.optim.epochs,
+            )
+        self.gram_weight_schedule = None
+        if self.gram_enabled and cfg.gram.get("loss_weight_schedule"):
+            from dinov3_tpu.train.schedules import linear_warmup_cosine_decay
+
+            s = cfg.gram.loss_weight_schedule
+            L = cfg.train.OFFICIAL_EPOCH_LENGTH
+            self.gram_weight_schedule = linear_warmup_cosine_decay(
+                start=s["start"], peak=s["peak"], end=s["end"],
+                warmup_iterations=int(s.get("warmup_epochs", 0) * L),
+                total_iterations=L * cfg.optim.epochs,
+            )
+
+    # ---------------- init ----------------
+
+    def init_params(self, rng: jax.Array, batch: dict) -> dict:
+        """Initialize {"student", "teacher"[, "gram"]} with teacher == student."""
+        import flax.linen as nn
+
+        r_bb, r_dino, r_ibot = jax.random.split(rng, 3)
+        g = batch["global_crops"][:1]
+        bb = nn.meta.unbox(self.student_backbone.init(r_bb, g))["params"]
+        cls = jnp.zeros((1, self.embed_dim), self.policy.compute_dtype)
+        dino = nn.meta.unbox(self.dino_head.init(r_dino, cls))["params"]
+        ibot = nn.meta.unbox(self.ibot_head.init(r_ibot, cls))["params"]
+        student = {"backbone": bb, "dino_head": dino, "ibot_head": ibot}
+        teacher = jax.tree.map(jnp.copy, student)
+        params = {"student": student, "teacher": teacher}
+        if self.gram_enabled and not self.gram_uses_ema_teacher:
+            params["gram"] = jax.tree.map(jnp.copy, {"backbone": bb})
+        return params
+
+    def init_state(self) -> dict:
+        """Non-param training state (softmax-centering EMA centers)."""
+        return {
+            "dino_center": jnp.zeros((1, self.cfg.dino.head_n_prototypes),
+                                     self.policy.reduce_dtype),
+            "ibot_center": jnp.zeros((1, self.cfg.ibot.head_n_prototypes),
+                                     self.policy.reduce_dtype),
+        }
+
+    # ---------------- forwards ----------------
+
+    def _apply_backbone(self, module, params, x, masks=None, *, crop_kind,
+                        train, rngs=None):
+        return module.apply(
+            {"params": params}, x, masks, crop_kind=crop_kind,
+            deterministic=not train, rngs=rngs,
+        )
+
+    def _gather_masked(self, patch_tokens, mask_indices):
+        """[2B, T, D], [2B, M] -> [2B, M, D] (local, static-shape gather)."""
+        return jnp.take_along_axis(
+            patch_tokens, mask_indices[..., None], axis=1
+        )
+
+    def get_teacher_output(
+        self, teacher_params, batch, teacher_temp, state, update_centers=True
+    ):
+        g = batch["global_crops"]
+        n_g = 2
+        B = g.shape[0] // n_g
+        out = self._apply_backbone(
+            self.teacher_backbone, teacher_params["backbone"], g,
+            crop_kind="global", train=False,
+        )
+        cls = out["x_norm_clstoken"]  # [2B, D]
+        patches = out["x_norm_patchtokens"]  # [2B, T, D]
+        cls_logits = self.dino_head.apply(
+            {"params": teacher_params["dino_head"]}, cls
+        )  # [2B, K]
+        masked = self._gather_masked(patches, batch["mask_indices"])
+        M = masked.shape[1]
+        masked_logits = self.ibot_head.apply(
+            {"params": teacher_params["ibot_head"]},
+            masked.reshape(-1, self.embed_dim),
+        )  # [2B*M, K']
+        valid = batch["mask_valid"].reshape(-1)
+
+        new_state = dict(state)
+        if self.centering == "sinkhorn_knopp":
+            cls_centered = sinkhorn_knopp(cls_logits, teacher_temp)
+            masked_centered = sinkhorn_knopp(
+                masked_logits, teacher_temp,
+                row_weights=valid.astype(self.policy.reduce_dtype),
+            )
+        elif self.centering == "softmax_center":
+            cls_centered = softmax_center_teacher(
+                cls_logits, state["dino_center"], teacher_temp
+            )
+            masked_centered = softmax_center_teacher(
+                masked_logits, state["ibot_center"], teacher_temp
+            ) * valid[:, None]
+            if update_centers:
+                new_state["dino_center"] = update_center(
+                    state["dino_center"], cls_logits
+                )
+                w = valid.astype(self.policy.reduce_dtype)[:, None]
+                masked_mean = jnp.sum(masked_logits * w, axis=0, keepdims=True)
+                masked_mean = masked_mean / jnp.maximum(jnp.sum(w), 1.0)
+                new_state["ibot_center"] = (
+                    state["ibot_center"] * 0.9 + masked_mean * 0.1
+                )
+        else:
+            raise ValueError(f"unknown centering {self.centering!r}")
+
+        return {
+            "cls_pre_head": cls.reshape(n_g, B, -1),
+            "patch_pre_head": patches,
+            "cls_centered": cls_centered.reshape(n_g, B, -1),
+            "masked_patch_centered": masked_centered.reshape(2 * B, M, -1),
+        }, new_state
+
+    def get_student_output(self, student_params, batch, rngs):
+        g = batch["global_crops"]
+        l = batch["local_crops"]
+        n_g, n_l = 2, self.n_local_crops
+        B = g.shape[0] // n_g
+        masks = None if self.cfg.distillation.enabled else batch["masks"]
+        g_out = self._apply_backbone(
+            self.student_backbone, student_params["backbone"], g, masks,
+            crop_kind="global", train=True, rngs=rngs,
+        )
+        l_out = self._apply_backbone(
+            self.student_backbone, student_params["backbone"], l, None,
+            crop_kind="local", train=True,
+            rngs={k: jax.random.fold_in(v, 1) for k, v in rngs.items()},
+        )
+        g_cls, g_patch = g_out["x_norm_clstoken"], g_out["x_norm_patchtokens"]
+        l_cls = l_out["x_norm_clstoken"]
+
+        masked = self._gather_masked(g_patch, batch["mask_indices"])
+        M = masked.shape[1]
+        masked_logits = self.ibot_head.apply(
+            {"params": student_params["ibot_head"]},
+            masked.reshape(-1, self.embed_dim),
+        )
+        # one fused DINO-head call for global+local CLS
+        cls_cat = jnp.concatenate([g_cls, l_cls], axis=0)
+        cls_logits = self.dino_head.apply(
+            {"params": student_params["dino_head"]}, cls_cat
+        )
+        K = cls_logits.shape[-1]
+        g_logits = cls_logits[: n_g * B].reshape(n_g, B, K)
+        l_logits = cls_logits[n_g * B:].reshape(n_l, B, K)
+
+        global_out = {
+            "cls_pre_head": g_cls.reshape(n_g, B, -1),
+            "patch_pre_head": g_patch,
+            "cls_after_head": g_logits,
+            "masked_patch_after_head": masked_logits.reshape(2 * B, M, -1),
+        }
+        local_out = {
+            "cls_pre_head": l_cls.reshape(n_l, B, -1),
+            "cls_after_head": l_logits,
+        }
+        return global_out, local_out
+
+    def get_gram_teacher_output(self, params, batch, teacher_patches):
+        """Patch features anchoring the Gram loss.
+
+        Uses the dedicated frozen gram backbone on ``gram_teacher_crops``
+        when configured, else the EMA teacher's patches; resizes the patch
+        grid to the student's when resolutions differ
+        (reference: ssl_meta_arch.py get_gram_teacher_output + config
+        gram.global_teacher_resize_method).
+        """
+        if not self.gram_uses_ema_teacher and "gram" in params:
+            crops = batch.get("gram_teacher_crops")
+            if crops is None:
+                crops = batch["global_crops"]
+            out = self._apply_backbone(
+                self.teacher_backbone, params["gram"]["backbone"], crops,
+                crop_kind="global", train=False,
+            )
+            feats = out["x_norm_patchtokens"]
+        else:
+            feats = teacher_patches
+        feats = jax.lax.stop_gradient(feats)
+        # resize the gram teacher's patch grid onto the student grid
+        T_t = feats.shape[1]
+        p = self.cfg.student.patch_size
+        hs = ws = self.cfg.crops.global_crops_size // p
+        if T_t != hs * ws:
+            ht = wt = int(round(T_t ** 0.5))
+            grid = feats.reshape(feats.shape[0], ht, wt, feats.shape[-1])
+            grid = jax.image.resize(
+                grid, (feats.shape[0], hs, ws, feats.shape[-1]),
+                method=self.cfg.gram.global_teacher_resize_method,
+                antialias=self.cfg.gram.global_teacher_resize_antialias,
+            )
+            feats = grid.reshape(feats.shape[0], hs * ws, feats.shape[-1])
+        return feats
+
+    # ---------------- loss ----------------
+
+    def compute_losses(
+        self, teacher_global, student_global, student_local, gram_feats,
+        batch, iteration,
+    ):
+        cfg = self.cfg
+        n_g = 2
+        n_l = self.n_local_crops
+        ignore_diag = bool(cfg.dino.global_ignore_diagonal)
+        loss_dict = {}
+        total = jnp.zeros((), self.policy.reduce_dtype)
+
+        # crop-pair scales (reference compute_losses:480-489)
+        g_terms = n_g * (n_g - 1) if ignore_diag else n_g * n_g
+        l_terms = n_g * n_l
+        g_scale = g_terms / (g_terms + l_terms)
+        l_scale = l_terms / (g_terms + l_terms)
+
+        local_w = 1.0
+        if self.dino_local_weight_schedule is not None:
+            sched = jnp.asarray(self.dino_local_weight_schedule, jnp.float32)
+            local_w = sched[jnp.minimum(iteration, sched.shape[0] - 1)]
+
+        dino_local = dino_loss(
+            student_local["cls_after_head"], teacher_global["cls_centered"],
+        )
+        loss_dict["dino_local_crops_loss"] = dino_local
+        total = total + cfg.dino.loss_weight * l_scale * local_w * dino_local
+
+        dino_global = dino_loss(
+            student_global["cls_after_head"], teacher_global["cls_centered"],
+            ignore_diagonal=ignore_diag,
+        )
+        loss_dict["dino_global_crops_loss"] = dino_global
+        total = total + cfg.dino.loss_weight * g_scale * dino_global
+
+        # KoLeo per global crop over the batch (reference:519)
+        group = (cfg.dino.koleo_distributed_loss_group_size
+                 if cfg.dino.koleo_loss_distributed else None)
+        topk = cfg.dino.koleo_topk if cfg.dino.koleo_loss_distributed else 1
+        kol = sum(
+            koleo_loss(teacher_cls, topk=topk, group_size=group)
+            for teacher_cls in student_global["cls_pre_head"]
+        ) / n_g
+        loss_dict["koleo_loss"] = kol
+        total = total + cfg.dino.koleo_loss_weight * n_g * kol
+
+        # iBOT on masked tokens
+        from dinov3_tpu.losses import ibot_patch_loss_masked
+
+        w = batch["mask_weights"].reshape(-1)
+        n_images = batch["masks"].shape[0]
+        ibot = ibot_patch_loss_masked(
+            student_global["masked_patch_after_head"].reshape(
+                -1, cfg.ibot.head_n_prototypes),
+            teacher_global["masked_patch_centered"].reshape(
+                -1, cfg.ibot.head_n_prototypes),
+            w, n_images=n_images,
+        )
+        loss_dict["ibot_loss"] = ibot
+        total = total + cfg.ibot.loss_weight * ibot
+
+        if self.gram_enabled and gram_feats is not None:
+            gram_w = cfg.gram.loss_weight
+            if self.gram_weight_schedule is not None:
+                sched = jnp.asarray(self.gram_weight_schedule, jnp.float32)
+                gram_w = sched[jnp.minimum(iteration, sched.shape[0] - 1)]
+            g_loss = gram_loss(
+                student_global["patch_pre_head"], gram_feats,
+                normalize=cfg.gram.normalized,
+                img_level=cfg.gram.img_level,
+                remove_neg=cfg.gram.remove_neg,
+                remove_only_teacher_neg=cfg.gram.remove_only_teacher_neg,
+            )
+            loss_dict["gram_loss"] = g_loss
+            loss_dict["gram_loss_weight"] = jnp.asarray(gram_w, jnp.float32)
+            total = total + gram_w * g_loss
+
+        loss_dict["total_loss"] = total
+        return total, loss_dict
+
+    # ---------------- full forward ----------------
+
+    def forward(
+        self,
+        student_params,
+        frozen_params,
+        batch,
+        *,
+        teacher_temp,
+        state,
+        iteration,
+        rngs,
+        update_centers=True,
+    ):
+        """Loss for one batch. ``frozen_params`` = {"teacher": ..,
+        ["gram": ..]} under stop_gradient; gradients flow only through
+        ``student_params``."""
+        frozen = jax.lax.stop_gradient(frozen_params)
+        teacher_global, new_state = self.get_teacher_output(
+            frozen["teacher"], batch, teacher_temp, state, update_centers,
+        )
+        student_global, student_local = self.get_student_output(
+            student_params, batch, rngs
+        )
+        gram_feats = None
+        if self.gram_enabled:
+            gram_feats = self.get_gram_teacher_output(
+                frozen, batch, teacher_global["patch_pre_head"]
+            )
+        total, loss_dict = self.compute_losses(
+            teacher_global, student_global, student_local, gram_feats,
+            batch, iteration,
+        )
+        return total, (loss_dict, new_state)
+
+    def update_ema(self, teacher_params, student_params, momentum):
+        """teacher <- m * teacher + (1 - m) * student.
+
+        The reference updated a detached copy that never fed back
+        (SURVEY.md §2.9.1); here the result IS the teacher used next step.
+        """
+        return jax.tree.map(
+            lambda t, s: t * momentum + s.astype(t.dtype) * (1.0 - momentum),
+            teacher_params, student_params,
+        )

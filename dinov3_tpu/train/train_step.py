@@ -1,0 +1,92 @@
+"""The fused DINOv3 training step.
+
+One jitted program per step (reference split it across three separate
+jit+shard_map closures — train, EMA, metrics — train/train.py:588-604,
+412-419): forward (teacher + student) -> backward -> per-submodel grad clip
+-> scheduled-AdamW update -> teacher-EMA from the *updated* student params.
+Fusing the EMA both fixes the reference's frozen-teacher bug by construction
+(SURVEY.md §2.9.1) and lets XLA overlap the EMA's elementwise work with the
+optimizer update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dinov3_tpu.train.optimizer import clip_by_per_submodel_norm
+from dinov3_tpu.train.ssl_meta_arch import SSLMetaArch
+
+
+class TrainState(NamedTuple):
+    params: Any        # {"student": .., "teacher": .., ["gram": ..]}
+    opt_state: Any
+    center_state: Any  # softmax-centering EMA centers
+    step: jnp.ndarray
+
+
+def make_train_step(
+    meta: SSLMetaArch,
+    optimizer: optax.GradientTransformation,
+    clip_grad: float | None = 3.0,
+    monitor_grad_norm: bool = False,
+) -> Callable:
+    """Returns step(state, batch, scalars, rng) -> (state, metrics).
+
+    scalars: {"teacher_temp": f32, "momentum": f32} traced per-step values
+    (indexed from the schedule arrays by the caller or in-graph).
+    """
+
+    def step(state: TrainState, batch: dict, scalars: dict, rng: jax.Array):
+        it = state.step
+        rng = jax.random.fold_in(rng, it)
+        rngs = {
+            "drop_path": jax.random.fold_in(rng, 0),
+            "rope": jax.random.fold_in(rng, 1),
+            "dropout": jax.random.fold_in(rng, 2),
+        }
+        frozen = {k: v for k, v in state.params.items() if k != "student"}
+
+        def loss_fn(student_params):
+            return meta.forward(
+                student_params, frozen, batch,
+                teacher_temp=scalars["teacher_temp"],
+                state=state.center_state,
+                iteration=it,
+                rngs=rngs,
+            )
+
+        (loss, (loss_dict, new_centers)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params["student"])
+
+        metrics = dict(loss_dict)
+        if clip_grad is not None and clip_grad > 0:
+            grads, norms = clip_by_per_submodel_norm(grads, clip_grad)
+            if monitor_grad_norm:
+                for k, v in norms.items():
+                    metrics[f"grad_norm/{k}"] = v
+
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params["student"]
+        )
+        new_student = optax.apply_updates(state.params["student"], updates)
+        new_teacher = meta.update_ema(
+            state.params["teacher"], new_student, scalars["momentum"]
+        )
+        new_params = dict(state.params)
+        new_params["student"] = new_student
+        new_params["teacher"] = new_teacher
+
+        new_state = TrainState(
+            params=new_params,
+            opt_state=new_opt_state,
+            center_state=new_centers,
+            step=it + 1,
+        )
+        return new_state, metrics
+
+    return step

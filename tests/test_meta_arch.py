@@ -1,0 +1,160 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+from dinov3_tpu.data import make_synthetic_batch
+from dinov3_tpu.train import build_optimizer, build_schedules
+from dinov3_tpu.train.ssl_meta_arch import SSLMetaArch
+from dinov3_tpu.train.train_step import TrainState, make_train_step
+
+SMOL = [
+    "student.arch=vit_test", "student.patch_size=4",
+    "student.drop_path_rate=0.1", "student.layerscale=1.0e-5",
+    "crops.global_crops_size=16", "crops.local_crops_size=8",
+    "crops.local_crops_number=2",
+    "dino.head_n_prototypes=32", "dino.head_hidden_dim=24",
+    "dino.head_bottleneck_dim=8",
+    "ibot.head_n_prototypes=32", "ibot.head_hidden_dim=24",
+    "ibot.head_bottleneck_dim=8",
+    "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=4",
+    "optim.warmup_epochs=1", "optim.freeze_last_layer_epochs=1",
+    "compute_precision.compute_dtype=fp32",
+    "optim.scaling_rule=none",
+]
+
+
+def smol_cfg(extra=()):
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, list(SMOL) + list(extra))
+    return cfg
+
+
+def make_setup(extra=(), B=4):
+    cfg = smol_cfg(extra)
+    meta = SSLMetaArch(cfg)
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, B, seed=0).items()}
+    params = meta.init_params(jax.random.key(0), batch)
+    return cfg, meta, batch, params
+
+
+def test_init_params_structure():
+    _, meta, batch, params = make_setup()
+    assert set(params) == {"student", "teacher"}
+    for side in ("student", "teacher"):
+        assert set(params[side]) == {"backbone", "dino_head", "ibot_head"}
+    # teacher starts as an exact copy of the student
+    for a, b in zip(jax.tree.leaves(params["student"]),
+                    jax.tree.leaves(params["teacher"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_forward_losses_finite_and_complete():
+    _, meta, batch, params = make_setup()
+    rngs = {"drop_path": jax.random.key(1), "rope": jax.random.key(2),
+            "dropout": jax.random.key(3)}
+    total, (loss_dict, _) = meta.forward(
+        params["student"], {"teacher": params["teacher"]}, batch,
+        teacher_temp=0.07, state=meta.init_state(), iteration=0, rngs=rngs,
+    )
+    for key in ("dino_local_crops_loss", "dino_global_crops_loss",
+                "koleo_loss", "ibot_loss", "total_loss"):
+        assert key in loss_dict, key
+        assert np.isfinite(float(loss_dict[key])), key
+    assert float(total) == pytest.approx(float(loss_dict["total_loss"]))
+
+
+def test_gradients_touch_all_student_submodules():
+    _, meta, batch, params = make_setup()
+    rngs = {"drop_path": jax.random.key(1), "rope": jax.random.key(2),
+            "dropout": jax.random.key(3)}
+
+    def loss_fn(sp):
+        return meta.forward(
+            sp, {"teacher": params["teacher"]}, batch, teacher_temp=0.07,
+            state=meta.init_state(), iteration=0, rngs=rngs)[0]
+
+    grads = jax.grad(loss_fn)(params["student"])
+    for sub in ("backbone", "dino_head", "ibot_head"):
+        leaves = jax.tree.leaves(grads[sub])
+        total = sum(float(jnp.sum(jnp.abs(l))) for l in leaves)
+        assert total > 0, f"no gradient reached {sub}"
+        assert all(np.isfinite(np.asarray(l)).all() for l in leaves), sub
+
+
+def test_train_step_learns_and_teacher_moves():
+    cfg, meta, batch, params = make_setup()
+    sched = build_schedules(cfg)
+    opt = build_optimizer(cfg, params["student"], sched)
+    state = TrainState(params, opt.init(params["student"]),
+                       meta.init_state(), jnp.zeros((), jnp.int32))
+    step = jax.jit(make_train_step(meta, opt, clip_grad=3.0))
+    rng = jax.random.key(42)
+    teacher_before = jax.tree.leaves(state.params["teacher"])[0].copy()
+    losses = []
+    for i in range(8):
+        scal = sched.at(i)
+        scalars = {"teacher_temp": jnp.asarray(scal["teacher_temp"], jnp.float32),
+                   "momentum": jnp.asarray(0.9, jnp.float32)}
+        state, metrics = step(state, batch, scalars, rng)
+        losses.append(float(metrics["total_loss"]))
+    assert int(state.step) == 8
+    # same batch repeated: loss must drop
+    assert losses[-1] < losses[0], losses
+    # teacher EMA fed back (reference bug §2.9.1 fixed)
+    teacher_after = jax.tree.leaves(state.params["teacher"])[0]
+    assert not np.allclose(np.asarray(teacher_before), np.asarray(teacher_after))
+    # teacher remains a blend, not equal to student
+    student_after = jax.tree.leaves(state.params["student"])[0]
+    assert not np.allclose(np.asarray(teacher_after), np.asarray(student_after))
+
+
+def test_softmax_center_mode():
+    _, meta, batch, params = make_setup(
+        extra=["train.centering=softmax_center"])
+    rngs = {"drop_path": jax.random.key(1), "rope": jax.random.key(2),
+            "dropout": jax.random.key(3)}
+    state0 = meta.init_state()
+    total, (loss_dict, state1) = meta.forward(
+        params["student"], {"teacher": params["teacher"]}, batch,
+        teacher_temp=0.07, state=state0, iteration=0, rngs=rngs,
+    )
+    assert np.isfinite(float(total))
+    assert not np.allclose(np.asarray(state1["dino_center"]),
+                           np.asarray(state0["dino_center"]))
+
+
+def test_gram_loss_path():
+    _, meta, batch, params = make_setup(
+        extra=["gram.use_loss=true", "gram.it_load_ema_teacher=0",
+               "crops.gram_teacher_crops_size=16"])
+    assert "gram" in params
+    rngs = {"drop_path": jax.random.key(1), "rope": jax.random.key(2),
+            "dropout": jax.random.key(3)}
+    total, (loss_dict, _) = meta.forward(
+        params["student"],
+        {"teacher": params["teacher"], "gram": params["gram"]},
+        batch, teacher_temp=0.07, state=meta.init_state(), iteration=0,
+        rngs=rngs,
+    )
+    assert "gram_loss" in loss_dict
+    assert np.isfinite(float(loss_dict["gram_loss"]))
+
+
+def test_masking_buffers_consistency():
+    cfg = smol_cfg()
+    b = make_synthetic_batch(cfg, 4, seed=1)
+    masks, idx, w, valid = (b["masks"], b["mask_indices"], b["mask_weights"],
+                            b["mask_valid"])
+    for i in range(masks.shape[0]):
+        n = masks[i].sum()
+        k = valid[i].sum()
+        assert k == min(n, idx.shape[1])
+        if k:
+            # indices point at masked tokens, weights sum to ~1 per image
+            assert masks[i][idx[i][valid[i]]].all()
+            np.testing.assert_allclose(w[i].sum(), 1.0, rtol=1e-5)
+        else:
+            assert w[i].sum() == 0

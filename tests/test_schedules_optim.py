@@ -1,0 +1,225 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dinov3_tpu.configs import get_default_config, apply_dot_overrides
+from dinov3_tpu.train import (
+    build_multiplier_trees,
+    build_optimizer,
+    build_schedules,
+    clip_by_per_submodel_norm,
+    cosine_schedule,
+    linear_warmup_cosine_decay,
+    scheduled_adamw,
+)
+from dinov3_tpu.train.schedules import Schedules
+
+
+# ---------------- schedules ----------------
+
+def test_cosine_schedule_shape_and_endpoints():
+    s = cosine_schedule(1.0, 0.1, 100, warmup_iters=10, freeze_iters=5)
+    assert len(s) == 100
+    np.testing.assert_allclose(s[:5], 0.0)
+    np.testing.assert_allclose(s[5], 0.0)  # warmup starts at 0
+    np.testing.assert_allclose(s[14], 1.0, atol=0.12)  # warmup tops at base
+    np.testing.assert_allclose(s[15], 1.0, atol=1e-9)  # cos starts at base
+    assert s[-1] < 0.11  # decays toward final
+
+
+def test_cosine_trunc_extra_ends_at_final():
+    s = cosine_schedule(1.0, 0.01, 100, trunc_extra=0.25)
+    assert len(s) == 100
+    np.testing.assert_allclose(s[0], 1.0, atol=1e-9)
+    np.testing.assert_allclose(s[-1], 0.01, atol=1e-9)
+    assert np.all(np.diff(s) <= 1e-12)  # monotone decay
+
+
+def test_linear_warmup_cosine_decay_segments():
+    s = linear_warmup_cosine_decay(0.0, 1.0, 0.1, 10, 50, cosine_iterations=20)
+    assert len(s) == 50
+    assert s[9] < 1.0  # endpoint=False: warmup never hits peak early
+    np.testing.assert_allclose(s[10], 1.0, atol=1e-9)
+    np.testing.assert_allclose(s[30:], 0.1, atol=1e-9)  # constant tail
+
+
+def test_build_schedules_v1():
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        "train.OFFICIAL_EPOCH_LENGTH=10", "optim.epochs=10",
+        "optim.warmup_epochs=2", "optim.freeze_last_layer_epochs=1",
+        "teacher.warmup_teacher_temp_epochs=3", "optim.lr=0.002",
+    ])
+    s = build_schedules(cfg)
+    assert s.total_iters == 100
+    np.testing.assert_allclose(s.last_layer_lr[:10], 0.0)
+    assert s.last_layer_lr[15] == s.lr[15]
+    np.testing.assert_allclose(s.teacher_temp[0], 0.04, atol=1e-9)
+    np.testing.assert_allclose(s.teacher_temp[40:], 0.07, atol=1e-9)
+    np.testing.assert_allclose(s.momentum[0], 0.992, atol=1e-9)
+    np.testing.assert_allclose(s.momentum[-1], 1.0, atol=1e-3)
+    # .at clamps beyond the end
+    assert s.at(10**9)["lr"] == s.lr[-1]
+
+
+def test_build_schedules_v2():
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        "train.OFFICIAL_EPOCH_LENGTH=10", "optim.epochs=10",
+    ])
+    cfg["schedules"] = {
+        "lr": {"start": 0.0, "peak": 1e-3, "end": 1e-6, "warmup_epochs": 2,
+               "freeze_last_layer_epochs": 1},
+        "weight_decay": {"start": 0.04, "peak": 0.04, "end": 0.4,
+                         "warmup_epochs": 0},
+        "momentum": {"start": 0.992, "peak": 0.992, "end": 1.0,
+                     "warmup_epochs": 0},
+        "teacher_temp": {"start": 0.04, "peak": 0.07, "end": 0.07,
+                         "warmup_epochs": 3},
+    }
+    s = build_schedules(cfg)
+    np.testing.assert_allclose(s.last_layer_lr[:10], 0.0)
+    np.testing.assert_allclose(s.lr[20], 1e-3, rtol=1e-6)
+    np.testing.assert_allclose(s.weight_decay[-1], 0.4, rtol=1e-6)
+
+
+# ---------------- param groups ----------------
+
+def fake_params(n_blocks=3):
+    p = {
+        "backbone": {
+            "patch_embed": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))},
+            "cls_token": jnp.ones((1, 1, 2)),
+            "norm": {"scale": jnp.ones((2,)), "bias": jnp.ones((2,))},
+        },
+        "dino_head": {
+            "mlp_0": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))},
+            "prototypes": jnp.ones((2, 8)),
+        },
+    }
+    for i in range(n_blocks):
+        p["backbone"][f"blocks_{i}"] = {
+            "attn": {"qkv_kernel": jnp.ones((2, 6))},
+            "ls1": {"gamma": jnp.ones((2,))},
+        }
+    return p
+
+
+def test_multiplier_trees_semantics():
+    params = fake_params()
+    lr, wd, ll = build_multiplier_trees(
+        params, layerwise_decay=0.9, patch_embed_lr_mult=0.2,
+        dino_head_wd_multiplier=0.5,
+    )
+    d = 0.9
+    # patch embed: layer 0 decay * 0.2 mult
+    np.testing.assert_allclose(lr["backbone"]["patch_embed"]["kernel"],
+                               d ** 4 * 0.2, rtol=1e-6)
+    np.testing.assert_allclose(lr["backbone"]["cls_token"], d ** 4, rtol=1e-6)
+    # block i -> decay^(L+1-(i+1))
+    np.testing.assert_allclose(
+        lr["backbone"]["blocks_1"]["attn"]["qkv_kernel"], d ** 2, rtol=1e-6)
+    # head gets no layerwise decay (layer L+1 -> mult 1)
+    np.testing.assert_allclose(lr["dino_head"]["mlp_0"]["kernel"], 1.0)
+    # wd: biases/norms/gammas zero, head multiplied
+    assert wd["backbone"]["patch_embed"]["bias"] == 0.0
+    assert wd["backbone"]["norm"]["scale"] == 0.0
+    assert wd["backbone"]["blocks_0"]["ls1"]["gamma"] == 0.0
+    assert wd["dino_head"]["mlp_0"]["kernel"] == 0.5
+    assert wd["dino_head"]["mlp_0"]["bias"] == 0.0
+    assert wd["backbone"]["blocks_0"]["attn"]["qkv_kernel"] == 1.0
+    # last layer flag
+    assert ll["dino_head"]["prototypes"] is True
+    assert ll["dino_head"]["mlp_0"]["kernel"] is False
+
+
+def test_multiplier_trees_scanned_stack():
+    params = {"backbone": {"blocks": {"block": {
+        "attn": {"qkv_kernel": jnp.ones((4, 2, 6))}}},
+        "patch_embed": {"kernel": jnp.ones((2, 2))}}}
+    lr, _, _ = build_multiplier_trees(params, layerwise_decay=0.5)
+    stacked = lr["backbone"]["blocks"]["block"]["attn"]["qkv_kernel"]
+    assert stacked.shape == (4, 1, 1)
+    np.testing.assert_allclose(
+        np.asarray(stacked).ravel(), [0.5 ** 4, 0.5 ** 3, 0.5 ** 2, 0.5],
+        rtol=1e-6)
+
+
+# ---------------- optimizer ----------------
+
+def make_sched(n=10, lr=0.1, wd=0.0):
+    z = np.zeros(n)
+    return Schedules(np.full(n, lr), np.full(n, wd), z, z, np.zeros(n), n)
+
+
+def test_scheduled_adamw_matches_optax_adamw():
+    """With all multipliers 1 and constant schedules, our chain must equal
+    optax.adamw exactly."""
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros((3,))}
+    grads = {"w": jnp.full((3, 3), 0.5), "b": jnp.ones((3,))}
+    sched = make_sched(lr=0.1, wd=0.04)
+    ones = jax.tree.map(lambda _: 1.0, params)
+    falses = jax.tree.map(lambda _: False, params)
+    opt = scheduled_adamw(sched, ones, ones, falses)
+    ref = optax.adamw(0.1, weight_decay=0.04)
+    s1, s2 = opt.init(params), ref.init(params)
+    p1, p2 = params, params
+    for _ in range(3):
+        g = grads
+        u1, s1 = opt.update(g, s1, p1)
+        p1 = optax.apply_updates(p1, u1)
+        u2, s2 = ref.update(g, s2, p2)
+        p2 = optax.apply_updates(p2, u2)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   atol=1e-6)
+
+
+def test_last_layer_freeze_and_multipliers():
+    params = {"proto": jnp.ones((2, 2)), "w": jnp.ones((2, 2))}
+    sched = Schedules(
+        lr=np.array([0.1, 0.1]), weight_decay=np.zeros(2),
+        momentum=np.zeros(2), teacher_temp=np.zeros(2),
+        last_layer_lr=np.array([0.0, 0.1]), total_iters=2,
+    )
+    lr_mult = {"proto": 1.0, "w": 0.5}
+    wd_mult = {"proto": 1.0, "w": 1.0}
+    is_ll = {"proto": True, "w": False}
+    opt = scheduled_adamw(sched, lr_mult, wd_mult, is_ll)
+    state = opt.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    u, state = opt.update(g, state, params)
+    np.testing.assert_allclose(np.asarray(u["proto"]), 0.0)  # frozen step 0
+    assert np.abs(np.asarray(u["w"])).max() > 0
+    u2, state = opt.update(g, state, params)
+    assert np.abs(np.asarray(u2["proto"])).max() > 0  # unfrozen step 1
+    # lr_mult halves w's step relative to proto's
+    np.testing.assert_allclose(np.asarray(u2["w"]) * 2, np.asarray(u2["proto"]),
+                               atol=1e-7)
+
+
+def test_build_optimizer_from_cfg_runs():
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, ["train.OFFICIAL_EPOCH_LENGTH=5", "optim.epochs=2"])
+    params = fake_params()
+    sched = build_schedules(cfg)
+    opt = build_optimizer(cfg, params, sched)
+    state = opt.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    u, _ = opt.update(g, state, params)
+    assert jax.tree.structure(u) == jax.tree.structure(params)
+
+
+def test_clip_per_submodel():
+    grads = {
+        "backbone": {"w": jnp.full((2, 2), 100.0)},
+        "dino_head": {"w": jnp.full((2,), 1e-4)},
+    }
+    clipped, norms = clip_by_per_submodel_norm(grads, max_norm=3.0)
+    bb_norm = float(jnp.sqrt(jnp.sum(clipped["backbone"]["w"] ** 2)))
+    np.testing.assert_allclose(bb_norm, 3.0, rtol=1e-5)
+    # small grads untouched
+    np.testing.assert_allclose(np.asarray(clipped["dino_head"]["w"]), 1e-4)
+    assert float(norms["backbone"]) > 3.0
