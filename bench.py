@@ -1,0 +1,82 @@
+"""Headline benchmark: DINOv3 pretrain throughput, images/sec/chip.
+
+Runs the full fused training step (teacher fwd + student fwd/bwd on
+2 global + 8 local crops + Sinkhorn + AdamW + EMA) for ViT-L/16 on the
+available device(s) with synthetic data, and prints ONE JSON line:
+
+    {"metric": "...", "value": N, "unit": "img/s/chip", "vs_baseline": N}
+
+Baseline: the reference codebase publishes no JAX numbers (SURVEY.md §6);
+its configs record Meta's PyTorch run at 0.57 s/iter for global batch 2048
+on 32 A100-class GPUs = 112 img/s/GPU (vitl_im1k_lin834.yaml:3-4).
+``vs_baseline`` is img/s/chip divided by that 112 img/s/GPU anchor.
+
+Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 32),
+BENCH_STEPS (10), BENCH_WARMUP (3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMG_S_PER_CHIP = 112.0  # Meta PyTorch ViT-L run, per A100
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.data import make_synthetic_batch
+    from dinov3_tpu.train import build_train_setup, put_batch
+
+    arch = os.environ.get("BENCH_ARCH", "vit_large")
+    per_chip = int(os.environ.get("BENCH_BATCH", "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    n = jax.device_count()
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        f"student.arch={arch}",
+        "student.n_storage_tokens=4",
+        "student.drop_path_rate=0.3",
+        "optim.scaling_rule=none",
+        "parallel.data=-1",
+    ])
+    B = per_chip * n
+    batch_np = make_synthetic_batch(cfg, B, seed=0)
+    batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+
+    setup = build_train_setup(cfg, batch)
+    dbatch = put_batch(batch, setup.batch_shardings)
+    rng = jax.random.key(0)
+    state = setup.state
+    scalars = setup.scalars(0)
+
+    for _ in range(warmup):
+        state, metrics = setup.step_fn(state, dbatch, scalars, rng)
+    jax.block_until_ready(metrics["total_loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = setup.step_fn(state, dbatch, scalars, rng)
+    jax.block_until_ready(metrics["total_loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    img_s_chip = B / dt / n
+    print(json.dumps({
+        "metric": f"dinov3_pretrain_{arch}_imgs_per_sec_per_chip",
+        "value": round(img_s_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

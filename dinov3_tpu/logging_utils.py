@@ -1,0 +1,175 @@
+"""Logging + training metrics.
+
+(reference: dinov3_jax/logging/__init__.py (colored rank-aware logger) and
+logging/helpers.py (``MetricLogger``/``SmoothedValue`` windowed meters
+driving the train loop with ETA/iter-time lines + a JSON-lines metrics
+dump). Same observable surface, fixed problems: the reference's
+``SmoothedValue.synchronize_between_processes`` called ``lax.psum`` outside
+shard_map (broken, SURVEY.md §2.8) — here cross-host sync is unnecessary
+because step metrics come out of the jitted step already globally reduced
+by GSPMD; and the logger writes through stdlib handlers only on the main
+process.)
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import sys
+import time
+from collections import defaultdict, deque
+from typing import Iterable
+
+logger = logging.getLogger("dinov3")
+
+
+def setup_logging(
+    output_dir: str | None = None,
+    level: int = logging.INFO,
+    rank: int | None = None,
+) -> None:
+    """Console + per-rank file logging, main process only on console."""
+    root = logging.getLogger("dinov3")
+    if root.handlers:
+        return
+    root.setLevel(level)
+    root.propagate = False
+    fmt = logging.Formatter(
+        fmt="%(asctime)s %(levelname).1s %(name)s %(filename)s:%(lineno)d] "
+            "%(message)s",
+        datefmt="%Y%m%d %H:%M:%S",
+    )
+    if rank is None:
+        try:
+            import jax
+
+            rank = jax.process_index()
+        except Exception:
+            rank = 0
+    if rank == 0:
+        sh = logging.StreamHandler(sys.stdout)
+        sh.setFormatter(fmt)
+        root.addHandler(sh)
+    if output_dir:
+        os.makedirs(output_dir, exist_ok=True)
+        suffix = "" if rank == 0 else f".rank{rank}"
+        fh = logging.FileHandler(os.path.join(output_dir, f"log{suffix}.txt"))
+        fh.setFormatter(fmt)
+        root.addHandler(fh)
+
+
+class SmoothedValue:
+    """Windowed median/avg meter (reference: logging/helpers.py:24-83)."""
+
+    def __init__(self, window_size: int = 20, fmt: str = "{median:.4f} ({global_avg:.4f})"):
+        self.deque: deque = deque(maxlen=window_size)
+        self.total = 0.0
+        self.count = 0
+        self.fmt = fmt
+
+    def update(self, value: float, num: int = 1) -> None:
+        self.deque.append(value)
+        self.count += num
+        self.total += value * num
+
+    @property
+    def median(self) -> float:
+        if not self.deque:
+            return 0.0
+        d = sorted(self.deque)
+        return d[len(d) // 2]
+
+    @property
+    def avg(self) -> float:
+        return sum(self.deque) / max(len(self.deque), 1)
+
+    @property
+    def global_avg(self) -> float:
+        return self.total / max(self.count, 1)
+
+    @property
+    def value(self) -> float:
+        return self.deque[-1] if self.deque else 0.0
+
+    def __str__(self) -> str:
+        return self.fmt.format(
+            median=self.median, avg=self.avg, global_avg=self.global_avg,
+            value=self.value,
+        )
+
+
+class MetricLogger:
+    """Iteration driver printing smoothed meters + ETA, dumping JSON lines.
+
+    (reference: logging/helpers.py:86-197.)
+    """
+
+    def __init__(self, delimiter: str = "  ", output_file: str | None = None):
+        self.meters: dict[str, SmoothedValue] = defaultdict(SmoothedValue)
+        self.delimiter = delimiter
+        self.output_file = output_file
+
+    def update(self, **kwargs) -> None:
+        for k, v in kwargs.items():
+            if hasattr(v, "item"):
+                v = float(v)
+            self.meters[k].update(float(v))
+
+    def __getattr__(self, attr):
+        if attr in self.meters:
+            return self.meters[attr]
+        raise AttributeError(attr)
+
+    def dump_json(self, iteration: int, iter_time: float, data_time: float) -> None:
+        if not self.output_file:
+            return
+        entry = {
+            "iteration": iteration,
+            "iter_time": iter_time,
+            "data_time": data_time,
+            **{k: m.median for k, m in self.meters.items()},
+        }
+        with open(self.output_file, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+
+    def log_every(
+        self,
+        iterable: Iterable,
+        print_freq: int = 10,
+        header: str = "",
+        n_iterations: int | None = None,
+        start_iteration: int = 0,
+    ):
+        i = start_iteration
+        if n_iterations is None:
+            try:
+                n_iterations = len(iterable)  # type: ignore[arg-type]
+            except TypeError:
+                n_iterations = None
+        iter_time = SmoothedValue(fmt="{avg:.4f}")
+        data_time = SmoothedValue(fmt="{avg:.4f}")
+        end = time.perf_counter()
+        for obj in iterable:
+            data_time.update(time.perf_counter() - end)
+            yield i, obj
+            iter_time.update(time.perf_counter() - end)
+            if i % print_freq == 0 or (n_iterations and i == n_iterations - 1):
+                self.dump_json(i, iter_time.avg, data_time.avg)
+                eta = ""
+                if n_iterations:
+                    secs = iter_time.global_avg * (n_iterations - i)
+                    eta = f"eta: {datetime.timedelta(seconds=int(secs))}  "
+                meters = self.delimiter.join(
+                    f"{name}: {meter}" for name, meter in self.meters.items()
+                )
+                total = f"/{n_iterations}" if n_iterations else ""
+                logger.info(
+                    f"{header} [{i}{total}]  {eta}{meters}  "
+                    f"time: {iter_time}  data: {data_time}"
+                )
+            i += 1
+            end = time.perf_counter()
+            if n_iterations and i >= n_iterations:
+                break

@@ -49,8 +49,10 @@ class DINOHead(nn.Module):
             else:
                 x = dense(self.hidden_dim, "mlp_0", ("embed", "mlp"))(x)
                 x = nn.gelu(x)
+                # middle layers are row-parallel (input dim carries the
+                # tensor shard; flax forbids a logical name twice per param)
                 for i in range(1, n - 1):
-                    x = dense(self.hidden_dim, f"mlp_{i}", ("mlp", "mlp"))(x)
+                    x = dense(self.hidden_dim, f"mlp_{i}", ("mlp", None))(x)
                     x = nn.gelu(x)
                 x = dense(self.bottleneck_dim, f"mlp_{n-1}", ("mlp", None))(x)
             # L2 normalize in fp32 (eps as in reference dino_head.py:80-82)

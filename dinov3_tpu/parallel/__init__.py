@@ -1,0 +1,40 @@
+"""Parallelism: device mesh, GSPMD sharding rules, multi-host bootstrap.
+
+Replaces the reference's hand-rolled per-module FSDP interceptor and
+single-axis "dp" shard_map program (dinov3_jax/fsdp/utils.py:19-110,
+dinov3_jax/train/train.py:322-354) with the TPU-native design from
+SURVEY.md §7.1: one global mesh with named axes
+``(dcn_data, data, fsdp, seq, tensor)``, parameters born sharded via
+``NamedSharding``, and XLA's SPMD partitioner inserting all collectives.
+"""
+
+from dinov3_tpu.parallel.distributed import (
+    initialize_distributed,
+    is_main_process,
+    process_count,
+    process_index,
+)
+from dinov3_tpu.parallel.mesh import MeshSpec, build_mesh
+from dinov3_tpu.parallel.sharding import (
+    DEFAULT_LOGICAL_RULES,
+    batch_sharding,
+    batch_specs,
+    make_sharded_init,
+    replicated,
+    state_shardings_from_abstract,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "initialize_distributed",
+    "is_main_process",
+    "process_count",
+    "process_index",
+    "DEFAULT_LOGICAL_RULES",
+    "batch_sharding",
+    "batch_specs",
+    "make_sharded_init",
+    "replicated",
+    "state_shardings_from_abstract",
+]

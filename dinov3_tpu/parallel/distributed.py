@@ -1,0 +1,87 @@
+"""Multi-host process bootstrap.
+
+(reference: dinov3_jax/distributed/__init__.py:12-21 hardcoded
+``get_rank() == 0`` / single host — the multi-host path never existed.
+Here ``jax.distributed.initialize`` is called per host before any device
+access; afterwards ``jax.devices()`` is the global device set and the mesh
+in parallel/mesh.py spans all hosts, with collectives riding ICI within a
+slice and DCN across slices.)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger("dinov3")
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize JAX's multi-host runtime if this looks like a multi-host
+    job; no-op otherwise (single host, tests, CPU simulation).
+
+    On Cloud TPU pods the arguments are auto-detected from the metadata
+    server, so a bare call is enough; explicit args / env vars
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
+    ``JAX_PROCESS_ID``) cover other clusters.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    num_processes = num_processes if num_processes is not None else (
+        int(env_np) if env_np else None
+    )
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+    explicit = coordinator_address is not None
+    on_tpu_pod = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "MEGASCALE_COORDINATOR_ADDRESS"
+    )
+    if not explicit and not on_tpu_pod:
+        logger.info("single-process run; skipping jax.distributed.initialize")
+        return
+    try:
+        if explicit:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            jax.distributed.initialize()  # auto-detect on a TPU pod
+    except (ValueError, RuntimeError) as e:
+        # tunneled single-chip setups look pod-like but aren't; stay single
+        logger.warning("jax.distributed.initialize skipped: %s", e)
+        return
+    _initialized = True
+    logger.info(
+        "distributed: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0

@@ -1,0 +1,105 @@
+"""Logical-axis -> mesh sharding rules and sharded-init helpers.
+
+Every parameter in dinov3_tpu/ops carries *logical* axis names
+(``part(...)`` in ops/common.py). This module maps them onto the physical
+mesh and produces the ``NamedSharding`` trees that drive ``jax.jit``
+in/out shardings — GSPMD replaces the reference's per-module
+all-gather/reduce-scatter interceptor (dinov3_jax/fsdp/utils.py:19-94,
+SURVEY.md §7.1): XLA inserts the identical collectives from the sharding
+annotations, overlapped with compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Logical axis -> mesh axis (or tuple of axes, or None = replicated).
+#
+# Parameter axes:
+#   embed  — the model dim of every kernel/bias: sharded over fsdp (ZeRO-3;
+#            all-gathered by XLA per layer on use).
+#   heads  — qkv out dim; mlp — FFN hidden; vocab — DINO-head prototypes:
+#            tensor-parallel (Megatron-style column/row split + 262k-proto
+#            head sharding, SURVEY.md §7.3).
+# Activation axes:
+#   batch   — global batch: split over every data-parallel axis.
+#   seq_act — patch-token dim under sequence/context parallelism.
+DEFAULT_LOGICAL_RULES = (
+    ("batch", ("dcn_data", "data", "fsdp")),
+    ("seq_act", "seq"),
+    ("embed", "fsdp"),
+    ("heads", "tensor"),
+    ("mlp", "tensor"),
+    ("vocab", "tensor"),
+    ("embed_act", None),
+)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, seq_dim: int | None = None) -> NamedSharding:
+    """Sharding for one batch leaf: dim 0 over all data axes, optional
+    token dim over seq."""
+    spec: list = [("dcn_data", "data", "fsdp")]
+    if seq_dim is not None:
+        spec.extend([None] * (seq_dim - 1))
+        spec.append("seq")
+    return NamedSharding(mesh, P(*spec))
+
+
+def batch_specs(mesh: Mesh, batch: dict) -> dict:
+    """NamedSharding tree for a collated batch dict (all leaves are
+    [global_batch, ...] arrays; scalars replicated)."""
+    return jax.tree.map(
+        lambda x: replicated(mesh) if getattr(x, "ndim", 0) == 0
+        else batch_sharding(mesh),
+        batch,
+    )
+
+
+def state_shardings_from_abstract(
+    abstract_boxed: Any, mesh: Mesh, rules=DEFAULT_LOGICAL_RULES
+) -> Any:
+    """NamedSharding tree from an ``eval_shape`` of a *boxed* init.
+
+    ``abstract_boxed`` is the pytree returned by
+    ``jax.eval_shape(boxed_init_fn, ...)`` where params still carry
+    ``nn.Partitioned`` logical metadata (optax state built from boxed
+    params keeps the boxes in its mu/nu subtrees — so one call covers
+    params AND optimizer state). Unboxed leaves (step counters, centers)
+    come out replicated.
+    """
+    logical_specs = nn.get_partition_spec(abstract_boxed)
+    return nn.logical_to_mesh_sharding(logical_specs, mesh, list(rules))
+
+
+def make_sharded_init(
+    boxed_init_fn: Callable,
+    mesh: Mesh,
+    rules=DEFAULT_LOGICAL_RULES,
+    example_args: tuple = (),
+    example_kwargs: dict | None = None,
+):
+    """Compile ``boxed_init_fn`` so its outputs are born sharded.
+
+    Returns ``(init_fn, shardings)``: ``init_fn(*args)`` produces the
+    *unboxed* state tree laid out per ``shardings`` (the reference
+    materialized replicated params then re-sharded with dynamic_slice —
+    fsdp/utils.py:19-53; here each device only ever materializes its own
+    shard).
+    """
+    example_kwargs = example_kwargs or {}
+    abstract = jax.eval_shape(boxed_init_fn, *example_args, **example_kwargs)
+    shardings = state_shardings_from_abstract(abstract, mesh, rules)
+
+    def unboxed_init(*args, **kwargs):
+        return nn.meta.unbox(boxed_init_fn(*args, **kwargs))
+
+    jit_init = jax.jit(unboxed_init, out_shardings=shardings)
+    return jit_init, shardings

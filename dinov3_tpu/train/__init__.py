@@ -10,9 +10,14 @@ from dinov3_tpu.train.schedules import (
     cosine_schedule,
     linear_warmup_cosine_decay,
 )
+from dinov3_tpu.train.setup import TrainSetup, build_train_setup, put_batch
+from dinov3_tpu.train.ssl_meta_arch import SSLMetaArch
+from dinov3_tpu.train.train_step import TrainState, make_train_step
 
 __all__ = [
     "build_optimizer", "clip_by_per_submodel_norm", "scheduled_adamw",
     "build_multiplier_trees", "Schedules", "build_schedules",
     "cosine_schedule", "linear_warmup_cosine_decay",
+    "TrainSetup", "build_train_setup", "put_batch",
+    "SSLMetaArch", "TrainState", "make_train_step",
 ]

@@ -112,16 +112,22 @@ class SSLMetaArch:
 
     # ---------------- init ----------------
 
-    def init_params(self, rng: jax.Array, batch: dict) -> dict:
-        """Initialize {"student", "teacher"[, "gram"]} with teacher == student."""
+    def init_params(self, rng: jax.Array, batch: dict, unbox: bool = True) -> dict:
+        """Initialize {"student", "teacher"[, "gram"]} with teacher == student.
+
+        ``unbox=False`` keeps the ``nn.Partitioned`` logical-axis metadata on
+        every leaf — the sharded-init path (parallel/sharding.py) needs it to
+        derive ``NamedSharding``s before materializing anything.
+        """
         import flax.linen as nn
 
+        maybe_unbox = nn.meta.unbox if unbox else (lambda t: t)
         r_bb, r_dino, r_ibot = jax.random.split(rng, 3)
         g = batch["global_crops"][:1]
-        bb = nn.meta.unbox(self.student_backbone.init(r_bb, g))["params"]
+        bb = maybe_unbox(self.student_backbone.init(r_bb, g))["params"]
         cls = jnp.zeros((1, self.embed_dim), self.policy.compute_dtype)
-        dino = nn.meta.unbox(self.dino_head.init(r_dino, cls))["params"]
-        ibot = nn.meta.unbox(self.ibot_head.init(r_ibot, cls))["params"]
+        dino = maybe_unbox(self.dino_head.init(r_dino, cls))["params"]
+        ibot = maybe_unbox(self.ibot_head.init(r_ibot, cls))["params"]
         student = {"backbone": bb, "dino_head": dino, "ibot_head": ibot}
         teacher = jax.tree.map(jnp.copy, student)
         params = {"student": student, "teacher": teacher}

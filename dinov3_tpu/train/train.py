@@ -1,0 +1,178 @@
+"""DINOv3 pretraining entry point.
+
+Usage (reference-compatible surface, dinov3_jax/train/train.py:51-72):
+
+    python -m dinov3_tpu.train.train \
+        --config-file configs/train/vitl_smoke.yaml \
+        --output-dir /tmp/run \
+        optim.epochs=1 train.batch_size_per_device=8
+
+Differences from the reference loop (all SURVEY.md §7.1 by design):
+- one fused jitted step (fwd+bwd+clip+adamw+EMA) instead of three
+  jit(shard_map) closures; the teacher EMA actually feeds back (§2.9.1);
+- multi-axis GSPMD mesh instead of the hand-rolled FSDP interceptor;
+- schedules indexed in-graph; only teacher_temp/momentum cross the host
+  boundary per step (as replicated scalars);
+- async orbax checkpointing with working retention (§2.9.3);
+- NaN watchdog preserved (>2 consecutive non-finite losses aborts);
+- optional jax.profiler trace window (the reference stopped a trace it
+  never started, §5.1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import math
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dinov3_tpu.checkpoint import Checkpointer
+from dinov3_tpu.configs import load_config, setup_job
+from dinov3_tpu.logging_utils import MetricLogger, setup_logging
+from dinov3_tpu.parallel import initialize_distributed, is_main_process
+from dinov3_tpu.train.setup import build_train_setup, put_batch
+
+logger = logging.getLogger("dinov3")
+
+
+def get_args_parser():
+    p = argparse.ArgumentParser("DINOv3 TPU pretraining")
+    p.add_argument("--config-file", default="", help="run recipe YAML")
+    p.add_argument("--output-dir", default=".", help="logs + checkpoints")
+    p.add_argument("--no-resume", action="store_true",
+                   help="do not resume from the latest checkpoint")
+    p.add_argument("--profile-steps", default="",
+                   help="'start,stop' step range to capture a jax profiler "
+                        "trace into <output-dir>/trace")
+    p.add_argument("--max-iterations", type=int, default=-1,
+                   help="hard cap on iterations (smoke runs)")
+    p.add_argument("opts", nargs="*", default=[],
+                   help="key.path=value config overrides")
+    return p
+
+
+def build_data_iterator(cfg, global_batch_size: int):
+    """Host-side data iterator yielding collated numpy batches."""
+    backend = cfg.data.backend
+    if backend == "synthetic":
+        from dinov3_tpu.data import SyntheticDataset
+
+        return iter(SyntheticDataset(cfg, global_batch_size,
+                                     seed=cfg.train.seed))
+    if backend in ("folder", "imagenet"):
+        from dinov3_tpu.data.pipeline import make_train_pipeline
+
+        return make_train_pipeline(cfg, global_batch_size)
+    raise ValueError(f"unknown data backend {backend!r}")
+
+
+def do_train(cfg, args) -> dict:
+    from dinov3_tpu.configs import global_batch_size
+
+    n_devices = jax.device_count()
+    B = global_batch_size(cfg)
+
+    data_iter = build_data_iterator(cfg, B)
+    first = {k: jnp.asarray(v) for k, v in next(data_iter).items()}
+    t0 = time.perf_counter()
+    setup = build_train_setup(cfg, first)
+    logger.info(
+        "mesh %s | global batch %d | %d devices | setup %.1fs",
+        dict(setup.mesh.shape), B, n_devices, time.perf_counter() - t0,
+    )
+
+    total_iters = cfg.optim.epochs * cfg.train.OFFICIAL_EPOCH_LENGTH
+    if args.max_iterations > 0:
+        total_iters = min(total_iters, args.max_iterations)
+
+    ckpt = Checkpointer(
+        f"{cfg.train.output_dir}/ckpt",
+        max_to_keep=cfg.checkpointing.max_to_keep,
+        keep_every=cfg.checkpointing.get("keep_every"),
+    )
+    state = setup.state
+    start_iter = 0
+    if not args.no_resume and ckpt.latest_step() is not None:
+        state = ckpt.restore(state)
+        start_iter = int(state.step)
+        logger.info("resumed at iteration %d", start_iter)
+
+    prof = None
+    if args.profile_steps:
+        a, b = (int(x) for x in args.profile_steps.split(","))
+        prof = (a, b)
+
+    metric_logger = MetricLogger(
+        output_file=f"{cfg.train.output_dir}/training_metrics.json"
+        if is_main_process() else None,
+    )
+    rng = jax.random.key(cfg.train.seed + 1)
+    nan_streak = 0
+    last_loss = math.nan
+    header = "Train"
+
+    batch0 = put_batch(first, setup.batch_shardings)
+    pending = batch0
+    for it, raw in metric_logger.log_every(
+        data_iter, print_freq=10, header=header,
+        n_iterations=total_iters, start_iteration=start_iter,
+    ):
+        batch = pending
+        # overlap next batch's host->device transfer with this step
+        if prof and it == prof[0]:
+            jax.profiler.start_trace(f"{cfg.train.output_dir}/trace")
+        state, metrics = setup.step_fn(state, batch, setup.scalars(it), rng)
+        pending = put_batch(
+            {k: jnp.asarray(v) for k, v in raw.items()},
+            setup.batch_shardings,
+        )
+
+        # host-side schedule values for the log line
+        sched = setup.schedules.at(it)
+        last_loss = float(metrics["total_loss"])
+        if not math.isfinite(last_loss):
+            nan_streak += 1
+            logger.warning("non-finite loss at iteration %d", it)
+            if nan_streak > 2:
+                ckpt.close()
+                raise RuntimeError(
+                    f"aborting: {nan_streak} consecutive non-finite losses"
+                )
+        else:
+            nan_streak = 0
+        metric_logger.update(
+            lr=sched["lr"], wd=sched["weight_decay"], mom=sched["momentum"],
+            teacher_temp=sched["teacher_temp"],
+            **{k: float(v) for k, v in metrics.items()},
+        )
+        if prof and it == prof[1]:
+            jax.tree.leaves(state.params)[0].block_until_ready()
+            jax.profiler.stop_trace()
+        if (it + 1) % cfg.checkpointing.period == 0 or it + 1 == total_iters:
+            ckpt.save(it + 1, state)
+        if it + 1 >= total_iters:
+            break
+
+    ckpt.close()
+    logger.info("training done at iteration %d, final loss %.4f",
+                int(state.step), last_loss)
+    return {"final_loss": last_loss, "iterations": int(state.step)}
+
+
+def main(argv=None):
+    args = get_args_parser().parse_args(argv)
+    initialize_distributed()
+    cfg = load_config(args.config_file or None, overrides=list(args.opts))
+    cfg.train.output_dir = args.output_dir
+    setup_job(cfg)
+    setup_logging(args.output_dir)
+    logger.info("config:\n%s", cfg)
+    return do_train(cfg, args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
