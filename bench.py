@@ -11,8 +11,9 @@ its configs record Meta's PyTorch run at 0.57 s/iter for global batch 2048
 on 32 A100-class GPUs = 112 img/s/GPU (vitl_im1k_lin834.yaml:3-4).
 ``vs_baseline`` is img/s/chip divided by that 112 img/s/GPU anchor.
 
-Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 32),
-BENCH_STEPS (10), BENCH_WARMUP (3).
+Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 8 — largest
+that fits a 16G v5e chip without remat; remat admits 32 but is net
+slower), BENCH_STEPS (10), BENCH_WARMUP (3).
 """
 
 from __future__ import annotations
@@ -36,7 +37,7 @@ def main():
     from dinov3_tpu.train import build_train_setup, put_batch
 
     arch = os.environ.get("BENCH_ARCH", "vit_large")
-    per_chip = int(os.environ.get("BENCH_BATCH", "32"))
+    per_chip = int(os.environ.get("BENCH_BATCH", "8"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
 
