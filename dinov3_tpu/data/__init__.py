@@ -1,4 +1,24 @@
+from dinov3_tpu.data.adapters import DatasetWithEnumeratedTargets
+from dinov3_tpu.data.augmentations import (
+    DataAugmentationDINO,
+    build_augmentation_from_cfg,
+)
+from dinov3_tpu.data.collate import collate_crops, collate_eval, mask_capacity
+from dinov3_tpu.data.loaders import (
+    DataLoader,
+    SamplerType,
+    make_data_loader,
+    make_dataset,
+    make_sampler,
+    prefetch_to_device,
+)
 from dinov3_tpu.data.masking import block_mask, sample_ibot_masks
+from dinov3_tpu.data.multires import CombineDataLoader
+from dinov3_tpu.data.samplers import (
+    EpochSampler,
+    InfiniteSampler,
+    ShardedInfiniteSampler,
+)
 from dinov3_tpu.data.synthetic import (
     SyntheticDataset,
     batch_spec,
@@ -6,6 +26,11 @@ from dinov3_tpu.data.synthetic import (
 )
 
 __all__ = [
-    "block_mask", "sample_ibot_masks", "SyntheticDataset", "batch_spec",
-    "make_synthetic_batch",
+    "DatasetWithEnumeratedTargets", "DataAugmentationDINO",
+    "build_augmentation_from_cfg", "collate_crops", "collate_eval",
+    "mask_capacity", "DataLoader", "SamplerType", "make_data_loader",
+    "make_dataset", "make_sampler", "prefetch_to_device", "block_mask",
+    "sample_ibot_masks", "CombineDataLoader", "EpochSampler",
+    "InfiniteSampler", "ShardedInfiniteSampler", "SyntheticDataset",
+    "batch_spec", "make_synthetic_batch",
 ]

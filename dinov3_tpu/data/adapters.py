@@ -1,0 +1,41 @@
+"""Dataset adapters.
+
+(reference: dinov3_jax/data/adapters.py ``DatasetWithEnumeratedTargets``
+:32-76 — wraps a dataset so targets become (index, target) pairs and
+optionally pads the length to a multiple of the eval world size, padding
+samples marked with target index -1.)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class DatasetWithEnumeratedTargets:
+    def __init__(self, dataset, pad_dataset: bool = False, num_replicas: int = 1):
+        self._dataset = dataset
+        self._pad = pad_dataset
+        self._num_replicas = num_replicas
+        n = len(dataset)
+        if pad_dataset and num_replicas > 1:
+            self._size = ((n + num_replicas - 1) // num_replicas) * num_replicas
+        else:
+            self._size = n
+
+    def get_image_relpath(self, index: int) -> Any:
+        return self._dataset.get_image_relpath(index % len(self._dataset))
+
+    def get_target(self, index: int) -> tuple[int, Any]:
+        if index >= len(self._dataset):
+            return (-1, None)
+        return (index, self._dataset.get_target(index))
+
+    def __getitem__(self, index: int):
+        wrapped = index % len(self._dataset)
+        image, target = self._dataset[wrapped]
+        if index >= len(self._dataset):
+            return image, (-1, target)
+        return image, (index, target)
+
+    def __len__(self) -> int:
+        return self._size

@@ -1,0 +1,70 @@
+"""ADE20K semantic-segmentation dataset (eval-oriented).
+
+(reference: dinov3_jax/data/datasets/ade20k.py — its ``__getitem__`` was
+stubbed to random arrays (:56-60); here the real file layout is read:
+``images/<split>/*.jpg`` with ``annotations/<split>/*.png`` label maps.)
+"""
+
+from __future__ import annotations
+
+import os
+from enum import Enum
+from typing import Callable, Optional
+
+import numpy as np
+from PIL import Image
+
+
+class _Split(Enum):
+    TRAIN = "training"
+    VAL = "validation"
+
+
+class ADE20K:
+    Split = _Split
+
+    def __init__(
+        self,
+        *,
+        root: str,
+        split: "ADE20K.Split" = _Split.VAL,
+        transform: Optional[Callable] = None,
+        target_transform: Optional[Callable] = None,
+        seed: int = 0,
+    ):
+        if isinstance(split, str):
+            split = _Split[split]
+        self.root = root
+        self.split = split
+        self.transform = transform
+        self.target_transform = target_transform
+        self.seed = seed
+        img_dir = os.path.join(root, "images", split.value)
+        if not os.path.isdir(img_dir):
+            raise FileNotFoundError(f"ADE20K images not found: {img_dir}")
+        self._names = sorted(
+            os.path.splitext(f)[0] for f in os.listdir(img_dir)
+            if f.endswith((".jpg", ".jpeg", ".png"))
+        )
+
+    def __getitem__(self, index: int):
+        name = self._names[index]
+        image = Image.open(
+            os.path.join(self.root, "images", self.split.value, name + ".jpg")
+        ).convert("RGB")
+        seg_path = os.path.join(
+            self.root, "annotations", self.split.value, name + ".png"
+        )
+        seg = (
+            np.asarray(Image.open(seg_path), np.int32)
+            if os.path.exists(seg_path) else None
+        )
+        rng = np.random.default_rng((self.seed, index))
+        if self.transform is not None:
+            image = self.transform(rng, image)
+        if self.target_transform is not None and seg is not None:
+            seg = self.target_transform(seg)
+        return image, seg
+
+    def __len__(self) -> int:
+        return len(self._names)

@@ -1,0 +1,57 @@
+"""COCO Captions dataset (image, caption-list) for retrieval-style evals.
+
+(reference: dinov3_jax/data/datasets/coco_captions.py — same role; the
+reference paired it with a vendored CLIP BPE tokenizer
+(thirdparty/CLIP/...) whose vocab file wasn't in-tree. Here captions are
+returned as raw strings and tokenization is the eval harness's concern.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict
+from typing import Callable, Optional
+
+import numpy as np
+from PIL import Image
+
+
+class CocoCaptions:
+    def __init__(
+        self,
+        *,
+        root: str,
+        annotations: str,
+        transform: Optional[Callable] = None,
+        target_transform: Optional[Callable] = None,
+        seed: int = 0,
+    ):
+        self.root = root
+        self.transform = transform
+        self.target_transform = target_transform
+        self.seed = seed
+        with open(annotations) as f:
+            meta = json.load(f)
+        self._images = {im["id"]: im["file_name"] for im in meta["images"]}
+        caps = defaultdict(list)
+        for ann in meta["annotations"]:
+            caps[ann["image_id"]].append(ann["caption"])
+        self._ids = sorted(self._images)
+        self._captions = caps
+
+    def __getitem__(self, index: int):
+        image_id = self._ids[index]
+        image = Image.open(
+            os.path.join(self.root, self._images[image_id])
+        ).convert("RGB")
+        captions = list(self._captions.get(image_id, []))
+        rng = np.random.default_rng((self.seed, index))
+        if self.transform is not None:
+            image = self.transform(rng, image)
+        if self.target_transform is not None:
+            captions = self.target_transform(captions)
+        return image, captions
+
+    def __len__(self) -> int:
+        return len(self._ids)

@@ -1,0 +1,137 @@
+"""ImageNet directory dataset with a cached numpy index.
+
+(reference: dinov3_jax/data/datasets/image_net.py — kept: the ``_Split``
+enum with TRAIN/VAL/TEST lengths, the "extra" directory of precomputed
+``entries-*.npy`` index tables, class-id/class-name lookups. Dropped: the
+stubbed I/O that fabricated random images (:170-195, SURVEY.md §2.9 —
+"do not replicate"). Layout on disk is the standard
+``root/<split>/<wnid>/<file>.JPEG`` tree; the first pass builds the entries
+table by scanning and caches it under ``extra/``.)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from enum import Enum
+from typing import Callable, Optional
+
+import numpy as np
+
+from dinov3_tpu.data.datasets.extended import ExtendedVisionDataset
+
+logger = logging.getLogger("dinov3_tpu")
+
+_ENTRIES_DTYPE = [
+    ("actual_index", "<u4"),
+    ("class_index", "<u4"),
+    ("relpath", "U255"),
+]
+
+
+class _Split(Enum):
+    TRAIN = "train"
+    VAL = "val"
+    TEST = "test"
+
+    @property
+    def length(self) -> int:
+        # reference image_net.py:40-46 split constants
+        return {
+            _Split.TRAIN: 1_281_167,
+            _Split.VAL: 50_000,
+            _Split.TEST: 100_000,
+        }[self]
+
+
+class ImageNet(ExtendedVisionDataset):
+    Split = _Split
+
+    def __init__(
+        self,
+        *,
+        split: "ImageNet.Split",
+        root: str,
+        extra: Optional[str] = None,
+        transform: Optional[Callable] = None,
+        target_transform: Optional[Callable] = None,
+        seed: int = 0,
+    ) -> None:
+        super().__init__(transform, target_transform, seed)
+        if isinstance(split, str):
+            split = _Split[split]
+        self.split = split
+        self.root = root
+        self.extra = extra or os.path.join(root, "extra")
+        self._entries: np.ndarray | None = None
+        self._class_ids: list[str] | None = None
+
+    # ---------------------------------------------------------- index
+
+    @property
+    def _entries_path(self) -> str:
+        return os.path.join(self.extra, f"entries-{self.split.value.upper()}.npy")
+
+    def _split_dir(self) -> str:
+        return os.path.join(self.root, self.split.value)
+
+    def _build_entries(self) -> np.ndarray:
+        split_dir = self._split_dir()
+        if not os.path.isdir(split_dir):
+            raise FileNotFoundError(
+                f"ImageNet split directory not found: {split_dir}"
+            )
+        class_ids = sorted(
+            d for d in os.listdir(split_dir)
+            if os.path.isdir(os.path.join(split_dir, d))
+        )
+        rows = []
+        for ci, wnid in enumerate(class_ids):
+            cdir = os.path.join(split_dir, wnid)
+            for fname in sorted(os.listdir(cdir)):
+                rows.append(
+                    (len(rows), ci, os.path.join(self.split.value, wnid, fname))
+                )
+        entries = np.array(rows, dtype=_ENTRIES_DTYPE)
+        os.makedirs(self.extra, exist_ok=True)
+        np.save(self._entries_path, entries)
+        np.save(
+            os.path.join(self.extra, f"class-ids-{self.split.value.upper()}.npy"),
+            np.array(class_ids),
+        )
+        logger.info("built ImageNet index: %d entries, %d classes",
+                    len(entries), len(class_ids))
+        return entries
+
+    def _get_entries(self) -> np.ndarray:
+        if self._entries is None:
+            if os.path.exists(self._entries_path):
+                self._entries = np.load(self._entries_path)
+            else:
+                self._entries = self._build_entries()
+        return self._entries
+
+    def get_class_ids(self) -> list[str]:
+        if self._class_ids is None:
+            path = os.path.join(
+                self.extra, f"class-ids-{self.split.value.upper()}.npy"
+            )
+            self._class_ids = list(np.load(path))
+        return self._class_ids
+
+    # ------------------------------------------------------------ data
+
+    def get_image_data(self, index: int) -> bytes:
+        entry = self._get_entries()[index]
+        path = os.path.join(self.root, str(entry["relpath"]))
+        with open(path, "rb") as f:
+            return f.read()
+
+    def get_target(self, index: int) -> int:
+        return int(self._get_entries()[index]["class_index"])
+
+    def get_targets(self) -> np.ndarray:
+        return self._get_entries()["class_index"].astype(np.int64)
+
+    def __len__(self) -> int:
+        return len(self._get_entries())

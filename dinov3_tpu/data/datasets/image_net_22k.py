@@ -1,0 +1,107 @@
+"""ImageNet-22k from per-class tarballs, read via mmap + a cached index.
+
+(reference: dinov3_jax/data/datasets/image_net_22k.py — same storage model:
+one ``<wnid>.tar`` per class holding raw JPEGs, an ``extra/`` directory of
+numpy index tables, and mmap'd zero-copy reads. The index here is built
+directly from the tar headers on first use instead of shipping
+preprocessed ``entries`` dumps.)
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import tarfile
+from functools import lru_cache
+from typing import Callable, Optional
+
+import numpy as np
+
+from dinov3_tpu.data.datasets.extended import ExtendedVisionDataset
+
+_ENTRIES_DTYPE = [
+    ("class_index", "<u4"),
+    ("tar_index", "<u4"),
+    ("offset", "<u8"),
+    ("size", "<u8"),
+]
+
+
+class ImageNet22k(ExtendedVisionDataset):
+    def __init__(
+        self,
+        *,
+        root: str,
+        extra: Optional[str] = None,
+        transform: Optional[Callable] = None,
+        target_transform: Optional[Callable] = None,
+        seed: int = 0,
+        mmap_cache_size: int = 16,
+    ):
+        super().__init__(transform, target_transform, seed)
+        self.root = root
+        self.extra = extra or os.path.join(root, "extra")
+        self._entries: np.ndarray | None = None
+        self._tar_names: list[str] | None = None
+        self._get_mmap = lru_cache(maxsize=mmap_cache_size)(self._open_mmap)
+
+    # ---------------------------------------------------------- index
+
+    @property
+    def _entries_path(self) -> str:
+        return os.path.join(self.extra, "entries-ALL.npy")
+
+    @property
+    def _tars_path(self) -> str:
+        return os.path.join(self.extra, "tar-names-ALL.npy")
+
+    def _build_entries(self) -> np.ndarray:
+        tars = sorted(
+            f for f in os.listdir(self.root) if f.endswith(".tar")
+        )
+        if not tars:
+            raise FileNotFoundError(f"no .tar class archives under {self.root}")
+        rows = []
+        for ti, tname in enumerate(tars):
+            with tarfile.open(os.path.join(self.root, tname)) as tf:
+                for member in tf:
+                    if not member.isfile():
+                        continue
+                    rows.append((ti, ti, member.offset_data, member.size))
+        entries = np.array(rows, dtype=_ENTRIES_DTYPE)
+        os.makedirs(self.extra, exist_ok=True)
+        np.save(self._entries_path, entries)
+        np.save(self._tars_path, np.array(tars))
+        return entries
+
+    def _get_entries(self) -> np.ndarray:
+        if self._entries is None:
+            if os.path.exists(self._entries_path):
+                self._entries = np.load(self._entries_path)
+                self._tar_names = list(np.load(self._tars_path))
+            else:
+                self._entries = self._build_entries()
+                self._tar_names = list(np.load(self._tars_path))
+        return self._entries
+
+    def _open_mmap(self, tar_index: int) -> mmap.mmap:
+        path = os.path.join(self.root, str(self._tar_names[tar_index]))
+        with open(path, "rb") as f:
+            return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+
+    # ------------------------------------------------------------ data
+
+    def get_image_data(self, index: int) -> bytes:
+        e = self._get_entries()[index]
+        m = self._get_mmap(int(e["tar_index"]))
+        off, size = int(e["offset"]), int(e["size"])
+        return m[off: off + size]
+
+    def get_target(self, index: int) -> int:
+        return int(self._get_entries()[index]["class_index"])
+
+    def get_targets(self) -> np.ndarray:
+        return self._get_entries()["class_index"].astype(np.int64)
+
+    def __len__(self) -> int:
+        return len(self._get_entries())

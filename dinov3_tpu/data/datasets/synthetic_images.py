@@ -1,0 +1,60 @@
+"""Synthetic PIL-image dataset: exercises the FULL host pipeline
+(decode -> augment -> collate) with no disk.
+
+(reference analogue: the stubbed decoders in
+dinov3_jax/data/datasets/decoders.py:31-34 fabricated random images deep
+inside the real dataset path; here synthetic data is an explicit dataset
+type selectable via the dataset string ``Synthetic:size=10000``.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+from PIL import Image
+
+from dinov3_tpu.data.datasets.extended import ExtendedVisionDataset
+
+
+class SyntheticImages(ExtendedVisionDataset):
+    def __init__(
+        self,
+        *,
+        size: int = 10_000,
+        image_size: int = 256,
+        n_classes: int = 1000,
+        transform: Optional[Callable] = None,
+        target_transform: Optional[Callable] = None,
+        seed: int = 0,
+    ):
+        super().__init__(transform, target_transform, seed)
+        self.size = int(size)
+        self.image_size = int(image_size)
+        self.n_classes = int(n_classes)
+
+    def __getitem__(self, index: int):
+        rng = np.random.default_rng((self.seed, index, 0))
+        arr = rng.integers(
+            0, 256, (self.image_size, self.image_size, 3), dtype=np.uint8
+        )
+        image = Image.fromarray(arr)
+        target = self.get_target(index)
+        trng = self.sample_rng(index)
+        if self.transform is not None:
+            image = self.transform(trng, image)
+        if self.target_transform is not None:
+            target = self.target_transform(target)
+        return image, target
+
+    def get_target(self, index: int) -> int:
+        rng = np.random.default_rng((self.seed, index, 1))
+        return int(rng.integers(0, self.n_classes))
+
+    def get_targets(self) -> np.ndarray:
+        return np.asarray(
+            [self.get_target(i) for i in range(self.size)], np.int64
+        )
+
+    def __len__(self) -> int:
+        return self.size

@@ -1,0 +1,269 @@
+"""Dataset factory + pipelined host data loader.
+
+(reference: dinov3_jax/data/loaders.py — same dataset-string grammar
+(``"ImageNet:split=TRAIN:root=..."`` :55-84) and sampler-type factory
+(:89-158), but the torch ``DataLoader(num_workers=0)`` (which blocked the
+train loop on augmentation every step, SURVEY.md §3.4) is replaced by a
+thread-pool pipeline: workers decode+augment individual samples, batches
+assemble in submission order, and ``prefetch_to_device`` double-buffers
+ready batches into HBM with their ``NamedSharding`` while the TPU step
+runs.)
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from enum import Enum
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from dinov3_tpu.data.samplers import (
+    EpochSampler,
+    InfiniteSampler,
+    ShardedInfiniteSampler,
+)
+
+logger = logging.getLogger("dinov3_tpu")
+
+
+class SamplerType(Enum):
+    EPOCH = "epoch"
+    INFINITE = "infinite"
+    SHARDED_INFINITE = "sharded_infinite"
+
+
+# ------------------------------------------------------- dataset strings
+
+
+def _parse_dataset_str(dataset_str: str) -> tuple[str, dict]:
+    tokens = dataset_str.split(":")
+    name = tokens[0]
+    kwargs = {}
+    for token in tokens[1:]:
+        key, _, value = token.partition("=")
+        if not _:
+            raise ValueError(f"malformed dataset string token {token!r}")
+        kwargs[key] = value
+    return name, kwargs
+
+
+def make_dataset(
+    dataset_str: str,
+    transform: Optional[Callable] = None,
+    target_transform: Optional[Callable] = None,
+    seed: int = 0,
+):
+    """``"ImageNet:split=TRAIN:root=/data/in1k"`` -> dataset instance
+    (reference loaders.py:22-52)."""
+    from dinov3_tpu.data import datasets as D
+
+    name, kwargs = _parse_dataset_str(dataset_str)
+    registry: dict[str, Any] = {
+        "ImageNet": D.ImageNet,
+        "ImageNet22k": D.ImageNet22k,
+        "ADE20K": D.ADE20K,
+        "CocoCaptions": D.CocoCaptions,
+        "Synthetic": D.SyntheticImages,
+    }
+    if name not in registry:
+        raise ValueError(f"unknown dataset {name!r} (have {sorted(registry)})")
+    for int_key in ("size", "image_size", "n_classes"):
+        if int_key in kwargs:
+            kwargs[int_key] = int(kwargs[int_key])
+    logger.info('making dataset "%s"', dataset_str)
+    return registry[name](
+        transform=transform, target_transform=target_transform, seed=seed,
+        **kwargs,
+    )
+
+
+def make_sampler(
+    dataset,
+    type: SamplerType = SamplerType.SHARDED_INFINITE,
+    shuffle: bool = True,
+    seed: int = 0,
+    rank: int = 0,
+    world_size: int = 1,
+    advance: int = 0,
+):
+    cls = {
+        SamplerType.EPOCH: EpochSampler,
+        SamplerType.INFINITE: InfiniteSampler,
+        SamplerType.SHARDED_INFINITE: ShardedInfiniteSampler,
+    }[type]
+    sampler = cls(
+        size=len(dataset), rank=rank, world_size=world_size,
+        shuffle=shuffle, seed=seed,
+    )
+    if advance:
+        sampler.advance(advance)
+    return sampler
+
+
+# ------------------------------------------------------------- data loader
+
+
+class DataLoader:
+    """Pipelined loader: ``num_workers`` threads map ``dataset[i]``,
+    batches are collated in order, up to ``prefetch_batches`` stay ready.
+
+    PIL decode/resize and numpy release the GIL for their hot loops, so a
+    thread pool reaches multi-core throughput without the pickling cost of
+    multiprocessing (and plays nicely with the single-process-per-host JAX
+    runtime).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        sampler,
+        batch_size: int,
+        collate_fn: Callable[[list], Any],
+        num_workers: int = 8,
+        prefetch_batches: int = 2,
+        drop_last: bool = True,
+    ):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.num_workers = max(1, num_workers)
+        self.prefetch_batches = max(1, prefetch_batches)
+        self.drop_last = drop_last
+
+    def _index_batches(self) -> Iterator[list[int]]:
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __iter__(self) -> Iterator[Any]:
+        out_q: queue.Queue = queue.Queue(maxsize=self.prefetch_batches)
+        stop = threading.Event()
+        _SENTINEL = object()
+
+        def producer():
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                try:
+                    pending: "queue.Queue" = queue.Queue()
+                    index_iter = self._index_batches()
+                    # keep a window of batches in flight
+                    for _ in range(self.prefetch_batches):
+                        idxs = next(index_iter, None)
+                        if idxs is None:
+                            break
+                        pending.put(
+                            (idxs, [pool.submit(self.dataset.__getitem__, i)
+                                    for i in idxs]))
+                    while not pending.empty():
+                        if stop.is_set():
+                            return
+                        idxs, futures = pending.get()
+                        samples = [f.result() for f in futures]
+                        nxt = next(index_iter, None)
+                        if nxt is not None:
+                            pending.put(
+                                (nxt, [pool.submit(self.dataset.__getitem__, i)
+                                       for i in nxt]))
+                        out_q.put(self.collate_fn(samples))
+                except Exception as e:  # surface worker errors to consumer
+                    out_q.put(e)
+                finally:
+                    out_q.put(_SENTINEL)
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = out_q.get()
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # drain so the producer can exit
+            while True:
+                try:
+                    out_q.get_nowait()
+                except queue.Empty:
+                    break
+
+
+def make_data_loader(
+    dataset,
+    batch_size: int,
+    collate_fn: Callable,
+    *,
+    num_workers: int = 8,
+    shuffle: bool = True,
+    seed: int = 0,
+    rank: int = 0,
+    world_size: int = 1,
+    sampler_type: SamplerType = SamplerType.SHARDED_INFINITE,
+    sampler_advance: int = 0,
+    drop_last: bool = True,
+    prefetch_batches: int = 2,
+) -> DataLoader:
+    """(reference loaders.py:161-216, with live sampler selection)"""
+    sampler = make_sampler(
+        dataset, sampler_type, shuffle=shuffle, seed=seed, rank=rank,
+        world_size=world_size, advance=sampler_advance,
+    )
+    return DataLoader(
+        dataset, sampler, batch_size, collate_fn,
+        num_workers=num_workers, prefetch_batches=prefetch_batches,
+        drop_last=drop_last,
+    )
+
+
+# ----------------------------------------------------- device-side prefetch
+
+
+def prefetch_to_device(
+    host_iter: Iterator[dict],
+    shardings: dict,
+    depth: int = 2,
+) -> Iterator[dict]:
+    """Move batches host->HBM ahead of consumption (double buffering).
+
+    ``shardings``: leaf name -> ``jax.sharding.Sharding``; extra leaves are
+    transferred uncommitted. The reference had no prefetch — its loop
+    blocked on augmentation + device_put every step (SURVEY.md §3.4).
+    """
+    import jax
+
+    def put(batch: dict) -> dict:
+        return {
+            k: jax.device_put(v, shardings.get(k)) for k, v in batch.items()
+        }
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _SENTINEL = object()
+
+    def worker():
+        try:
+            for batch in host_iter:
+                q.put(put(batch))
+        except Exception as e:
+            q.put(e)
+        finally:
+            q.put(_SENTINEL)
+
+    threading.Thread(target=worker, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            return
+        if isinstance(item, Exception):
+            raise item
+        yield item

@@ -1,0 +1,49 @@
+"""Multi-resolution crop schedule: combine per-resolution loaders by ratio.
+
+(reference: dinov3_jax/train/train.py:718-769
+``build_multi_resolution_data_loader_from_cfg`` — built one loader per
+(global_size, local_size, gram_size) triple and referenced a
+``CombineDataLoader`` that did not exist in the tree (:763, SURVEY.md
+§2.6) so only single-resolution worked. This module supplies the real
+combiner: an infinite interleave that draws each batch from loader k with
+probability ratio_k using a seeded host RNG — deterministic and
+resumable. Each resolution keeps its own jit cache entry (one compile per
+crop shape, SURVEY.md §7.3 "variable-shape multi-crop batches").)
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+
+class CombineDataLoader:
+    """Draw batches from ``loaders`` with probabilities ``ratios``."""
+
+    def __init__(self, loaders: Sequence, ratios: Sequence[float], seed: int = 0):
+        if len(loaders) != len(ratios):
+            raise ValueError("need one ratio per loader")
+        total = float(sum(ratios))
+        if total <= 0:
+            raise ValueError("ratios must sum to a positive value")
+        self.loaders = list(loaders)
+        self.ratios = [float(r) / total for r in ratios]
+        self.seed = seed
+        self._drawn = 0
+
+    def advance(self, n: int) -> None:
+        """Skip n draws (resume): keeps the choice stream aligned."""
+        self._drawn += n
+
+    def __iter__(self) -> Iterator:
+        iters = [iter(ld) for ld in self.loaders]
+        rng = np.random.default_rng(self.seed)
+        if self._drawn:
+            rng.choice(len(iters), size=self._drawn, p=self.ratios)
+        while True:
+            k = int(rng.choice(len(iters), p=self.ratios))
+            try:
+                yield next(iters[k])
+            except StopIteration:
+                return

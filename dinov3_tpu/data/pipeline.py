@@ -1,0 +1,132 @@
+"""End-to-end SSL train pipeline: dataset string -> multi-crop batches.
+
+Wires the pieces of this package together for the trainer
+(reference: dinov3_jax/train/train.py:773-843
+``build_data_loader_from_cfg`` — masking generator + dataset + augmented
+loader + collate; here the masks are sampled inside the collate step and
+the loader is the pipelined thread-pool one).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator
+
+import numpy as np
+
+from dinov3_tpu.data.augmentations import build_augmentation_from_cfg
+from dinov3_tpu.data.collate import collate_crops
+from dinov3_tpu.data.loaders import (
+    DataLoader,
+    SamplerType,
+    make_data_loader,
+    make_dataset,
+)
+
+
+def _collate_for_cfg(cfg, samples_with_targets, rng: np.random.Generator):
+    samples = [s for s, _ in samples_with_targets]
+    return collate_crops(
+        samples,
+        rng,
+        patch_size=cfg.student.patch_size,
+        global_crops_size=cfg.crops.global_crops_size,
+        mask_ratio_min_max=tuple(cfg.ibot.mask_ratio_min_max),
+        mask_probability=cfg.ibot.mask_sample_probability,
+    )
+
+
+class _SeededCollate:
+    """Fresh mask RNG per batch, deterministic given (seed, batch ordinal)."""
+
+    def __init__(self, cfg, seed: int):
+        self.cfg = cfg
+        self.seed = seed
+        self.ordinal = 0
+
+    def __call__(self, samples):
+        rng = np.random.default_rng((self.seed, self.ordinal))
+        self.ordinal += 1
+        return _collate_for_cfg(self.cfg, samples, rng)
+
+
+def make_train_pipeline(
+    cfg,
+    global_batch_size: int,
+    rank: int = 0,
+    world_size: int = 1,
+    sampler_advance: int = 0,
+) -> Iterator[dict]:
+    """Yields collated numpy batch dicts (the meta-arch batch contract).
+
+    ``global_batch_size`` is split evenly across hosts; each host loads its
+    ``global/world`` shard and the device layer shards further over the
+    mesh's data axes.
+    """
+    if global_batch_size % world_size:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{world_size} hosts"
+        )
+    local_batch = global_batch_size // world_size
+
+    augment = build_augmentation_from_cfg(cfg)
+
+    def transform(rng, image):
+        return augment(rng, image)
+
+    dataset_str = cfg.train.dataset_path
+    if cfg.data.backend == "folder" and cfg.data.get("root"):
+        if ":root=" not in dataset_str:
+            dataset_str = f"{dataset_str}:root={cfg.data.root}"
+    dataset = make_dataset(dataset_str, transform=transform,
+                           seed=cfg.train.seed)
+
+    loader = make_data_loader(
+        dataset,
+        batch_size=local_batch,
+        collate_fn=_SeededCollate(cfg, cfg.train.seed + rank),
+        num_workers=cfg.train.get("num_workers", 8),
+        shuffle=True,
+        seed=cfg.train.seed,
+        rank=rank,
+        world_size=world_size,
+        sampler_type=SamplerType.SHARDED_INFINITE,
+        sampler_advance=sampler_advance,
+        drop_last=True,
+        prefetch_batches=cfg.data.get("prefetch", 2),
+    )
+    return iter(loader)
+
+
+def make_multires_train_pipeline(
+    cfg,
+    global_batch_size: int,
+    rank: int = 0,
+    world_size: int = 1,
+) -> Iterator[dict]:
+    """Multi-resolution variant: one pipeline per (global, local, gram)
+    crop-size triple, combined by ``crops.crop_size_ratios``
+    (reference train.py:718-769, with the missing combiner implemented in
+    data/multires.py)."""
+    from dinov3_tpu.data.multires import CombineDataLoader
+
+    crops = cfg.crops
+    sizes = crops.get("global_local_crop_size_pairs")
+    ratios = crops.get("crop_size_ratios")
+    if not sizes:
+        return make_train_pipeline(cfg, global_batch_size, rank, world_size)
+    import copy
+
+    loaders = []
+    for pair in sizes:
+        sub = copy.deepcopy(cfg)
+        sub.crops.global_crops_size = int(pair[0])
+        sub.crops.local_crops_size = int(pair[1])
+        if len(pair) > 2 and pair[2]:
+            sub.crops.gram_teacher_crops_size = int(pair[2])
+        loaders.append(
+            make_train_pipeline(sub, global_batch_size, rank, world_size)
+        )
+    ratios = list(ratios or [1.0] * len(loaders))
+    return iter(CombineDataLoader(loaders, ratios, seed=cfg.train.seed))

@@ -1,0 +1,280 @@
+"""Host data pipeline: transforms, augmentations, collate, samplers,
+loaders, datasets, multires combiner."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+from dinov3_tpu.data import (
+    CombineDataLoader,
+    DataAugmentationDINO,
+    DatasetWithEnumeratedTargets,
+    EpochSampler,
+    InfiniteSampler,
+    ShardedInfiniteSampler,
+    collate_crops,
+    make_data_loader,
+    make_dataset,
+)
+from dinov3_tpu.data.transforms import (
+    ColorJitter,
+    center_crop,
+    make_classification_eval_transform,
+    random_resized_crop,
+    resize_shorter_side,
+    to_normalized_array,
+)
+
+
+def _img(size=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return Image.fromarray(
+        rng.integers(0, 256, (size, size, 3), dtype=np.uint8)
+    )
+
+
+def _smol_cfg():
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        "crops.global_crops_size=32", "crops.local_crops_size=16",
+        "crops.local_crops_number=4", "student.patch_size=4",
+    ])
+    return cfg
+
+
+# ------------------------------------------------------------- transforms
+
+
+def test_random_resized_crop_shape_and_determinism():
+    img = _img(100)
+    a = random_resized_crop(np.random.default_rng(3), img, 32)
+    b = random_resized_crop(np.random.default_rng(3), img, 32)
+    assert a.size == (32, 32)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resize_center_crop_normalize():
+    img = _img(80)
+    out = center_crop(resize_shorter_side(img, 64), 48)
+    assert out.size == (48, 48)
+    arr = to_normalized_array(out)
+    assert arr.shape == (48, 48, 3) and arr.dtype == np.float32
+    t = make_classification_eval_transform(64, 48)
+    arr2 = t(np.random.default_rng(0), img)
+    assert np.allclose(arr, arr2)
+
+
+def test_color_jitter_changes_image_but_is_deterministic():
+    img = _img(32)
+    jit = ColorJitter(0.4, 0.4, 0.2, 0.1)
+    a = jit(np.random.default_rng(5), img)
+    b = jit(np.random.default_rng(5), img)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(img))
+
+
+# ---------------------------------------------------------- augmentations
+
+
+def test_dino_augmentation_output_contract():
+    aug = DataAugmentationDINO(
+        global_crops_scale=(0.3, 1.0), local_crops_scale=(0.05, 0.3),
+        local_crops_number=4, global_crops_size=32, local_crops_size=16,
+    )
+    out = aug(np.random.default_rng(0), _img(64))
+    assert len(out["global_crops"]) == 2
+    assert out["global_crops"][0].shape == (32, 32, 3)
+    assert len(out["local_crops"]) == 4
+    assert out["local_crops"][0].shape == (16, 16, 3)
+    assert out["global_crops_teacher"] is out["global_crops"]
+    assert "gram_teacher_crops" not in out
+
+
+def test_dino_augmentation_gram_and_subset_modes():
+    aug = DataAugmentationDINO(
+        global_crops_scale=(0.3, 1.0), local_crops_scale=(0.05, 0.3),
+        local_crops_number=4, global_crops_size=32, local_crops_size=16,
+        gram_teacher_crops_size=24, gram_teacher_no_distortions=True,
+        local_crops_subset_of_global_crops=True, patch_size=4,
+        teacher_no_color_jitter=True,
+    )
+    out = aug(np.random.default_rng(0), _img(64))
+    assert len(out["gram_teacher_crops"]) == 2
+    assert out["gram_teacher_crops"][0].shape == (24, 24, 3)
+    assert out["global_crops_teacher"] is not out["global_crops"]
+    assert len(out["offsets"]) == 4
+    for (rx, ry), crop in zip(out["offsets"], out["local_crops"]):
+        assert rx % 4 == 0 and ry % 4 == 0
+        assert crop.shape == (16, 16, 3)
+
+
+# ---------------------------------------------------------------- collate
+
+
+def test_collate_matches_meta_arch_contract():
+    cfg = _smol_cfg()
+    aug = DataAugmentationDINO(
+        global_crops_scale=(0.3, 1.0), local_crops_scale=(0.05, 0.3),
+        local_crops_number=4, global_crops_size=32, local_crops_size=16,
+    )
+    rng = np.random.default_rng(0)
+    samples = [aug(np.random.default_rng(i), _img(64, i)) for i in range(3)]
+    batch = collate_crops(
+        samples, rng, patch_size=4, global_crops_size=32,
+        mask_ratio_min_max=(0.1, 0.5), mask_probability=0.5,
+    )
+    T = (32 // 4) ** 2
+    assert batch["global_crops"].shape == (6, 32, 32, 3)
+    assert batch["local_crops"].shape == (12, 16, 16, 3)
+    assert batch["masks"].shape == (6, T)
+    C = batch["mask_indices"].shape[1]
+    assert batch["mask_weights"].shape == (6, C)
+    assert batch["mask_valid"].shape == (6, C)
+    # crop-major: rows 0..2 are crop0 of each image
+    assert np.allclose(batch["global_crops"][0], samples[0]["global_crops"][0])
+    assert np.allclose(batch["global_crops"][3], samples[0]["global_crops"][1])
+    # weights sum to 1 for each masked image
+    has = batch["mask_valid"].any(axis=1)
+    sums = batch["mask_weights"].sum(axis=1)
+    assert np.allclose(sums[has], 1.0)
+
+
+# ---------------------------------------------------------------- samplers
+
+
+@pytest.mark.parametrize("cls", [EpochSampler, InfiniteSampler,
+                                 ShardedInfiniteSampler])
+def test_samplers_shard_disjoint_and_resume(cls):
+    import itertools
+
+    size, world = 40, 4
+    streams = []
+    for r in range(world):
+        s = cls(size=size, rank=r, world_size=world, seed=7)
+        streams.append(list(itertools.islice(iter(s), 30)))
+    if cls is not InfiniteSampler:  # infinite draws i.i.d. — overlap allowed
+        epoch_len = size if cls is EpochSampler else size // world
+        for r, st in enumerate(streams):
+            block = st[: epoch_len // (world if cls is EpochSampler else 1)]
+            others = set().union(*(
+                set(o[: len(block)]) for i, o in enumerate(streams) if i != r
+            ))
+            assert not (set(block) & others)
+    # resume: advance(k) == skipping k draws
+    s_full = cls(size=size, rank=1, world_size=world, seed=7)
+    full = list(itertools.islice(iter(s_full), 20))
+    s_adv = cls(size=size, rank=1, world_size=world, seed=7)
+    k = 8 if cls is not EpochSampler else 8 * world
+    s_adv.advance(k)
+    resumed = list(itertools.islice(iter(s_adv), 12))
+    assert resumed == full[8:]
+
+
+# ------------------------------------------------------- loader + datasets
+
+
+def test_synthetic_dataset_loader_end_to_end():
+    cfg = _smol_cfg()
+    from dinov3_tpu.data.pipeline import make_train_pipeline
+
+    apply_dot_overrides(cfg, [
+        "train.dataset_path=Synthetic:size=64:image_size=64",
+        "train.num_workers=2",
+    ])
+    it = make_train_pipeline(cfg, global_batch_size=4)
+    b1 = next(it)
+    b2 = next(it)
+    assert b1["global_crops"].shape == (8, 32, 32, 3)
+    assert b1["local_crops"].shape == (16, 16, 16, 3)
+    assert b1["global_crops"].dtype == np.float32
+    assert not np.allclose(b1["global_crops"], b2["global_crops"])
+
+
+def test_imagenet_folder_dataset(tmp_path):
+    root = tmp_path / "in1k"
+    for split in ("train", "val"):
+        for wnid in ("n01440764", "n01443537"):
+            d = root / split / wnid
+            d.mkdir(parents=True)
+            for i in range(3):
+                _img(32, seed=i).save(d / f"{wnid}_{i}.JPEG")
+    from dinov3_tpu.data.datasets import ImageNet
+
+    ds = ImageNet(split="TRAIN", root=str(root),
+                  transform=lambda rng, im: to_normalized_array(im))
+    assert len(ds) == 6
+    img, target = ds[0]
+    assert img.shape == (32, 32, 3)
+    assert target in (0, 1)
+    assert ds.get_targets().tolist() == [0, 0, 0, 1, 1, 1]
+    # index caching round-trip
+    ds2 = ImageNet(split="TRAIN", root=str(root))
+    assert len(ds2) == 6
+    assert os.path.exists(root / "extra" / "entries-TRAIN.npy")
+
+
+def test_imagenet22k_tar_dataset(tmp_path):
+    import io
+    import tarfile
+
+    root = tmp_path / "in22k"
+    root.mkdir()
+    for wnid in ("n00001", "n00002"):
+        with tarfile.open(root / f"{wnid}.tar", "w") as tf:
+            for i in range(2):
+                buf = io.BytesIO()
+                _img(24, seed=i).save(buf, format="JPEG")
+                data = buf.getvalue()
+                info = tarfile.TarInfo(f"{wnid}_{i}.JPEG")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+    from dinov3_tpu.data.datasets import ImageNet22k
+
+    ds = ImageNet22k(root=str(root),
+                     transform=lambda rng, im: to_normalized_array(im))
+    assert len(ds) == 4
+    img, target = ds[0]
+    assert img.shape == (24, 24, 3)
+    assert sorted(set(ds.get_targets().tolist())) == [0, 1]
+
+
+def test_dataset_with_enumerated_targets():
+    from dinov3_tpu.data.datasets import SyntheticImages
+
+    base = SyntheticImages(size=5, image_size=8, n_classes=3)
+    ds = DatasetWithEnumeratedTargets(base, pad_dataset=True, num_replicas=4)
+    assert len(ds) == 8
+    _, (idx, t) = ds[2]
+    assert idx == 2 and t is not None
+    _, (idx, _) = ds[6]
+    assert idx == -1
+
+
+def test_combine_dataloader_ratio_and_determinism():
+    a = [{"src": "a", "i": i} for i in range(100)]
+    b = [{"src": "b", "i": i} for i in range(100)]
+    combined = CombineDataLoader([a, b], [0.75, 0.25], seed=3)
+    got = [x["src"] for _, x in zip(range(80), iter(combined))]
+    frac_a = got.count("a") / len(got)
+    assert 0.55 < frac_a < 0.95
+    combined2 = CombineDataLoader([a, b], [0.75, 0.25], seed=3)
+    got2 = [x["src"] for _, x in zip(range(80), iter(combined2))]
+    assert got == got2
+
+
+def test_loader_worker_error_propagates():
+    class Bad:
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            raise ValueError("boom")
+
+    loader = make_data_loader(
+        Bad(), batch_size=2, collate_fn=lambda s: s, num_workers=2,
+    )
+    with pytest.raises(ValueError, match="boom"):
+        next(iter(loader))
