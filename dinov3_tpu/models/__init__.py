@@ -57,6 +57,8 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
         kw["remat"] = "full"
     kernels = cfg.get("kernels") or {}
     kw["attn_impl"] = kernels.get("flash_attention", "auto")
+    parallel = cfg.get("parallel") or {}
+    kw["seq_parallel"] = int(parallel.get("seq", 1) or 1) > 1
     kw["scan_layers"] = bool(train.get("scan_layers", False))
     policy = Policy.from_cfg(cfg.compute_precision)
     kw["dtype"] = policy.compute_dtype

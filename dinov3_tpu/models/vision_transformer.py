@@ -84,6 +84,7 @@ class DinoVisionTransformer(nn.Module):
     pos_embed_rope_dtype: str = "fp32"
     # execution
     attn_impl: str = "auto"
+    seq_parallel: bool = False
     scan_layers: bool = False
     remat: str = "none"  # none | blocks | full
     dtype: Any = jnp.bfloat16
@@ -170,6 +171,7 @@ class DinoVisionTransformer(nn.Module):
             drop_path_rate=self.drop_path_rate,
             layerscale_init=self.layerscale_init,
             mask_k_bias=self.mask_k_bias, attn_impl=self.attn_impl,
+            seq_parallel=self.seq_parallel,
             dtype=self.dtype, param_dtype=self.param_dtype,
             reduce_dtype=self.reduce_dtype,
         )

@@ -79,6 +79,7 @@ class SelfAttention(nn.Module):
     proj_drop: float = 0.0
     mask_k_bias: bool = False
     attn_impl: str = "auto"
+    seq_parallel: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -121,7 +122,18 @@ class SelfAttention(nn.Module):
             sin, cos = rope
             q, k = rope_apply_with_prefix(q, k, sin, cos, dtype=self.reduce_dtype)
 
-        out = dispatch_attention(q, k, v, self.attn_impl, self.reduce_dtype)
+        out = None
+        if self.seq_parallel:
+            from dinov3_tpu.parallel.context import get_current_mesh
+
+            mesh = get_current_mesh()
+            if mesh is not None and int(mesh.shape.get("seq", 1)) > 1:
+                from dinov3_tpu.parallel.ring_attention import ring_attention
+
+                out = ring_attention(q, k, v, mesh,
+                                     reduce_dtype=self.reduce_dtype)
+        if out is None:
+            out = dispatch_attention(q, k, v, self.attn_impl, self.reduce_dtype)
         out = constrain(out.reshape(B, N, self.dim), ("batch", None, "embed_act"))
 
         proj_kernel = self.param(

@@ -32,6 +32,7 @@ class SelfAttentionBlock(nn.Module):
     layerscale_init: float | None = 1e-5
     mask_k_bias: bool = False
     attn_impl: str = "auto"
+    seq_parallel: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -54,7 +55,8 @@ class SelfAttentionBlock(nn.Module):
         attn_out = SelfAttention(
             dim=self.dim, num_heads=self.num_heads, qkv_bias=self.qkv_bias,
             proj_bias=self.proj_bias, mask_k_bias=self.mask_k_bias,
-            attn_impl=self.attn_impl, dtype=self.dtype,
+            attn_impl=self.attn_impl, seq_parallel=self.seq_parallel,
+            dtype=self.dtype,
             param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
             name="attn",
         )(make_norm_layer(self.norm_layer, name="norm1", **norm_kw)(x),

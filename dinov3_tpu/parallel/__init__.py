@@ -8,6 +8,11 @@ SURVEY.md §7.1: one global mesh with named axes
 ``NamedSharding``, and XLA's SPMD partitioner inserting all collectives.
 """
 
+from dinov3_tpu.parallel.context import (
+    get_current_mesh,
+    seq_axis_size,
+    set_current_mesh,
+)
 from dinov3_tpu.parallel.distributed import (
     initialize_distributed,
     is_main_process,
@@ -15,6 +20,10 @@ from dinov3_tpu.parallel.distributed import (
     process_index,
 )
 from dinov3_tpu.parallel.mesh import MeshSpec, build_mesh
+from dinov3_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_local,
+)
 from dinov3_tpu.parallel.sharding import (
     DEFAULT_LOGICAL_RULES,
     batch_sharding,
@@ -27,6 +36,11 @@ from dinov3_tpu.parallel.sharding import (
 __all__ = [
     "MeshSpec",
     "build_mesh",
+    "get_current_mesh",
+    "set_current_mesh",
+    "seq_axis_size",
+    "ring_attention",
+    "ring_attention_local",
     "initialize_distributed",
     "is_main_process",
     "process_count",

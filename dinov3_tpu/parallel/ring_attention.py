@@ -1,0 +1,140 @@
+"""Ring attention: exact sequence/context-parallel attention over a mesh axis.
+
+(reference: absent — dinov3_jax computed one dense
+``nn.dot_product_attention`` per device (layers/attention.py:116) with no
+sequence parallelism of any kind; SURVEY.md §5.7 flags ring/all-gather-KV
+attention over an ``sp`` axis as required for the 518-768 px and ViT-7B
+configs. This module supplies it TPU-style: K/V chunks rotate around the
+``seq`` mesh axis with ``lax.ppermute`` (riding ICI neighbor links) while
+each device keeps only its own query chunk, merging partial softmax
+statistics online — O(N/s) memory per device, exact to fused attention.)
+
+The public wrapper handles the non-divisible token counts ViT produces
+(CLS + register prefix): pads to a multiple of the axis size, masks padded
+keys by *global* position, and slices the pad back off.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    n_valid: int | None = None,
+    reduce_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Shard-local ring attention. Must run inside ``shard_map`` with
+    ``axis_name`` bound.
+
+    q, k, v: [B, C, h, d] — the local chunk of C = N_padded / axis_size
+    tokens. Returns the local [B, C, h, d] output chunk. ``n_valid``: the
+    real token count before padding (keys at global position >= n_valid
+    are masked); None means no padding anywhere.
+    """
+    B, C, h, d = q.shape
+    size = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = d ** -0.5
+    qf = q.astype(reduce_dtype) * scale
+
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def step(carry, _):
+        m, l, acc, kc, vc, src = carry
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, kc.astype(reduce_dtype),
+            preferred_element_type=reduce_dtype,
+        )  # [B, h, C, C]
+        if n_valid is not None:
+            gpos = src * C + jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, 1, C), 3
+            )
+            s = jnp.where(gpos < n_valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vc.astype(reduce_dtype),
+            preferred_element_type=reduce_dtype,
+        )
+        # rotate the K/V chunk to the next device; chunk held after the
+        # rotation originated on shard (src - 1) mod size
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        src = (src - 1) % size
+        return (m_new, l_new, acc_new, kc, vc, src), None
+
+    # initial carries derived from q so they carry the same device-varying
+    # manual-axes type as the loop outputs (shard_map scan vma rule)
+    qz = jnp.swapaxes(qf, 1, 2) * 0.0  # [B, h, C, d], all zeros
+    m0 = qz[..., :1] + NEG_INF
+    l0 = qz[..., :1]
+    acc0 = qz
+    (m, l, acc, _, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v, my), None, length=size
+    )
+    out = acc / jnp.maximum(l, 1e-37)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axes: tuple = ("dcn_data", "data", "fsdp"),
+    heads_axis: str | None = "tensor",
+    reduce_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """GSPMD-callable exact attention with the token dim sharded over
+    ``seq_axis``. q, k, v: [B, N, h, d] global arrays (inside jit).
+    """
+    size = int(mesh.shape[seq_axis])
+    if size == 1:
+        from dinov3_tpu.ops.attention import xla_attention
+
+        return xla_attention(q, k, v, reduce_dtype)
+    B, N, h, d = q.shape
+    n_padded = -(-N // size) * size
+    pad = n_padded - N
+    if pad:
+        cfgpad = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q, k, v = (jnp.pad(t, cfgpad) for t in (q, k, v))
+    # only shard batch/head dims that divide evenly; otherwise replicate
+    # that dim inside the island (results are identical either way)
+    import math
+
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
+    b_div = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    b_axes = batch_axes if (batch_axes and B % b_div == 0) else None
+    h_axis = (
+        heads_axis
+        if heads_axis in mesh.shape and h % int(mesh.shape[heads_axis]) == 0
+        else None
+    )
+    spec = P(b_axes, seq_axis, h_axis, None)
+    fn = functools.partial(
+        ring_attention_local,
+        axis_name=seq_axis,
+        n_valid=N if pad else None,
+        reduce_dtype=reduce_dtype,
+    )
+    out = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+    if pad:
+        out = out[:, :N]
+    return out

@@ -68,6 +68,9 @@ def build_train_setup(
     mesh = mesh if mesh is not None else build_mesh(
         MeshSpec.from_cfg(cfg.parallel), devices=devices
     )
+    from dinov3_tpu.parallel.context import set_current_mesh
+
+    set_current_mesh(mesh)
     meta = SSLMetaArch(cfg)
     schedules = build_schedules(cfg)
 
