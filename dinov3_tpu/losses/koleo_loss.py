@@ -44,5 +44,8 @@ def koleo_loss(
         nn_idx[..., None],
         axis=2,
     )  # [G, g, k, D]
-    dists = jnp.linalg.norm(xg[:, :, None, :] - neighbors, axis=-1) + eps
+    diff = xg[:, :, None, :] - neighbors
+    # eps inside the sqrt: norm() has a NaN gradient at exactly-coincident
+    # points (common at init when LayerScale collapses all CLS outputs)
+    dists = jnp.sqrt(jnp.sum(diff * diff, axis=-1) + eps * eps)
     return -jnp.mean(jnp.log(dists + eps))

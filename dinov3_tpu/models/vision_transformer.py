@@ -360,11 +360,14 @@ vit_so400m = _ctor(1152, 27, 18, 3.777777778)
 vit_huge2 = _ctor(1280, 32, 20, 4.0)
 vit_giant2 = _ctor(1536, 40, 24, 4.0)
 vit_7b = _ctor(4096, 40, 32, 3.0)
-# tiny config for tests/smoke runs (not in the reference ladder)
+# tiny configs for tests/smoke runs (not in the reference ladder);
+# vit_test_big is a distinct-width "teacher" for distillation tests
 vit_test = _ctor(64, 2, 2, 2.0)
+vit_test_big = _ctor(96, 3, 2, 2.0)
 
 ARCHS = {
     "vit_small": vit_small, "vit_base": vit_base, "vit_large": vit_large,
     "vit_so400m": vit_so400m, "vit_huge2": vit_huge2,
     "vit_giant2": vit_giant2, "vit_7b": vit_7b, "vit_test": vit_test,
+    "vit_test_big": vit_test_big,
 }

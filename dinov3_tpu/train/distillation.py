@@ -1,0 +1,84 @@
+"""Distillation support: frozen pretrained teacher from its own config.
+
+(reference: dinov3_jax/train/ssl_meta_arch.py ``_setup_distillation``
+:257-286 — loads the teacher's full config from
+``distillation.full_cfg_path``, asserts prototype/patch compatibility,
+and builds the teacher backbone + heads from it. The reference never
+loaded the weights (``checkpoint_path`` unused) and its meta-arch still
+EMA-blended the teacher; here the teacher restores from a framework
+checkpoint and is exempt from the EMA by construction.)
+"""
+
+from __future__ import annotations
+
+import logging
+
+from dinov3_tpu.configs import ConfigNode, load_config
+
+logger = logging.getLogger("dinov3")
+
+
+def resolve_distillation_cfg(cfg: ConfigNode) -> ConfigNode:
+    """Merged (default <- teacher yaml) config for the frozen teacher."""
+    path = cfg.distillation.full_cfg_path
+    if not path:
+        raise ValueError(
+            "distillation.enabled=true requires distillation.full_cfg_path"
+        )
+    teacher_cfg = load_config(path)
+    if not teacher_cfg.ibot.separate_head:
+        raise ValueError("distillation teacher must use ibot.separate_head")
+    for section in ("dino", "ibot"):
+        t = teacher_cfg[section]["head_n_prototypes"]
+        s = cfg[section]["head_n_prototypes"]
+        if t != s:
+            raise ValueError(
+                f"{section}.head_n_prototypes mismatch: teacher {t} vs "
+                f"student {s} (losses share the prototype space)"
+            )
+    if teacher_cfg.student.patch_size != cfg.student.patch_size:
+        raise ValueError(
+            "teacher and student patch_size must match "
+            f"({teacher_cfg.student.patch_size} vs {cfg.student.patch_size})"
+        )
+    logger.info("distillation teacher config: %s", path)
+    return teacher_cfg
+
+
+def load_teacher_params(cfg: ConfigNode, state, state_shardings):
+    """Restore the frozen teacher's weights from a framework checkpoint.
+
+    ``distillation.checkpoint_path`` points at a Checkpointer directory of
+    the teacher's own pretraining run; its **teacher** branch (the EMA
+    weights — the ones DINOv3 evaluates and distills from) is restored
+    into this run's ``params["teacher"]`` subtree, sharded per this run's
+    layout.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = cfg.distillation.checkpoint_path
+    if not path:
+        return state
+    with ocp.CheckpointManager(path) as manager:
+        step = manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no teacher checkpoint under {path}")
+        target = state.params["teacher"]
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            target, state_shardings.params["teacher"],
+        )
+        restored = manager.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.PyTreeRestore(
+                    {"params": {"teacher": abstract}},
+                    partial_restore=True,
+                )
+            ),
+        )
+    new_params = dict(state.params)
+    new_params["teacher"] = restored["state"]["params"]["teacher"]
+    logger.info("loaded distillation teacher from %s step %d", path, step)
+    return state._replace(params=new_params)

@@ -57,8 +57,19 @@ class SSLMetaArch:
         self.cfg = cfg
         self.policy = Policy.from_cfg(cfg.compute_precision)
         self.student_backbone = build_backbone(cfg, teacher=False)
-        self.teacher_backbone = build_backbone(cfg, teacher=True)
+        # Distillation: the teacher is a different (frozen, pretrained)
+        # architecture resolved from its own config
+        # (reference: ssl_meta_arch.py _setup_distillation:257-286).
+        self.distillation = bool(cfg.distillation.enabled)
+        teacher_cfg = cfg
+        if self.distillation:
+            from dinov3_tpu.train.distillation import resolve_distillation_cfg
+
+            teacher_cfg = resolve_distillation_cfg(cfg)
+        self.teacher_cfg = teacher_cfg
+        self.teacher_backbone = build_backbone(teacher_cfg, teacher=True)
         self.embed_dim = self.student_backbone.embed_dim
+        self.teacher_embed_dim = self.teacher_backbone.embed_dim
 
         head_kw = dict(
             dtype=self.policy.compute_dtype,
@@ -81,6 +92,28 @@ class SSLMetaArch:
             norm_last_layer=cfg.ibot.head_norm_last_layer,
             **head_kw,
         )
+        if self.distillation:
+            # teacher heads may use different widths; prototype counts are
+            # asserted equal by resolve_distillation_cfg
+            self.teacher_dino_head = DINOHead(
+                out_dim=cfg.dino.head_n_prototypes,
+                hidden_dim=teacher_cfg.dino.head_hidden_dim,
+                bottleneck_dim=teacher_cfg.dino.head_bottleneck_dim,
+                nlayers=teacher_cfg.dino.head_nlayers,
+                norm_last_layer=teacher_cfg.dino.head_norm_last_layer,
+                **head_kw,
+            )
+            self.teacher_ibot_head = DINOHead(
+                out_dim=cfg.ibot.head_n_prototypes,
+                hidden_dim=teacher_cfg.ibot.head_hidden_dim,
+                bottleneck_dim=teacher_cfg.ibot.head_bottleneck_dim,
+                nlayers=teacher_cfg.ibot.head_nlayers,
+                norm_last_layer=teacher_cfg.ibot.head_norm_last_layer,
+                **head_kw,
+            )
+        else:
+            self.teacher_dino_head = self.dino_head
+            self.teacher_ibot_head = self.ibot_head
         self.n_local_crops = cfg.crops.local_crops_number
         self.centering = cfg.train.centering
         self.gram_enabled = bool(cfg.gram.use_loss)
@@ -129,7 +162,21 @@ class SSLMetaArch:
         dino = maybe_unbox(self.dino_head.init(r_dino, cls))["params"]
         ibot = maybe_unbox(self.ibot_head.init(r_ibot, cls))["params"]
         student = {"backbone": bb, "dino_head": dino, "ibot_head": ibot}
-        teacher = jax.tree.map(jnp.copy, student)
+        if self.distillation:
+            r_tb, r_td, r_ti = jax.random.split(jax.random.fold_in(rng, 7), 3)
+            tbb = maybe_unbox(self.teacher_backbone.init(r_tb, g))["params"]
+            tcls = jnp.zeros(
+                (1, self.teacher_embed_dim), self.policy.compute_dtype
+            )
+            teacher = {
+                "backbone": tbb,
+                "dino_head": maybe_unbox(
+                    self.teacher_dino_head.init(r_td, tcls))["params"],
+                "ibot_head": maybe_unbox(
+                    self.teacher_ibot_head.init(r_ti, tcls))["params"],
+            }
+        else:
+            teacher = jax.tree.map(jnp.copy, student)
         params = {"student": student, "teacher": teacher}
         if self.gram_enabled and not self.gram_uses_ema_teacher:
             params["gram"] = jax.tree.map(jnp.copy, {"backbone": bb})
@@ -169,16 +216,16 @@ class SSLMetaArch:
             self.teacher_backbone, teacher_params["backbone"], g,
             crop_kind="global", train=False,
         )
-        cls = out["x_norm_clstoken"]  # [2B, D]
-        patches = out["x_norm_patchtokens"]  # [2B, T, D]
-        cls_logits = self.dino_head.apply(
+        cls = out["x_norm_clstoken"]  # [2B, D_t]
+        patches = out["x_norm_patchtokens"]  # [2B, T, D_t]
+        cls_logits = self.teacher_dino_head.apply(
             {"params": teacher_params["dino_head"]}, cls
         )  # [2B, K]
         masked = self._gather_masked(patches, batch["mask_indices"])
         M = masked.shape[1]
-        masked_logits = self.ibot_head.apply(
+        masked_logits = self.teacher_ibot_head.apply(
             {"params": teacher_params["ibot_head"]},
-            masked.reshape(-1, self.embed_dim),
+            masked.reshape(-1, self.teacher_embed_dim),
         )  # [2B*M, K']
         valid = batch["mask_valid"].reshape(-1)
 
@@ -419,7 +466,11 @@ class SSLMetaArch:
 
         The reference updated a detached copy that never fed back
         (SURVEY.md §2.9.1); here the result IS the teacher used next step.
+        Under distillation the teacher is a frozen pretrained model and is
+        returned unchanged.
         """
+        if self.distillation:
+            return teacher_params
         return jax.tree.map(
             lambda t, s: t * momentum + s.astype(t.dtype) * (1.0 - momentum),
             teacher_params, student_params,

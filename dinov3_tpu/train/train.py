@@ -100,6 +100,10 @@ def do_train(cfg, args) -> dict:
         state = ckpt.restore(state)
         start_iter = int(state.step)
         logger.info("resumed at iteration %d", start_iter)
+    elif cfg.distillation.enabled and cfg.distillation.checkpoint_path:
+        from dinov3_tpu.train.distillation import load_teacher_params
+
+        state = load_teacher_params(cfg, state, setup.state_shardings)
 
     prof = None
     if args.profile_steps:

@@ -1,0 +1,174 @@
+"""Distillation (frozen bigger teacher) and multi-distillation subgroup
+resolution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import yaml
+
+from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+from dinov3_tpu.data import make_synthetic_batch
+from dinov3_tpu.train.multidistillation import (
+    enumerate_subgroup_ranks,
+    setup_multidistillation,
+)
+
+SMOL = [
+    "student.patch_size=4", "student.drop_path_rate=0.0",
+    "crops.global_crops_size=16", "crops.local_crops_size=8",
+    "crops.local_crops_number=2",
+    "dino.head_n_prototypes=64", "dino.head_hidden_dim=32",
+    "dino.head_bottleneck_dim=16",
+    "ibot.head_n_prototypes=64", "ibot.head_hidden_dim=32",
+    "ibot.head_bottleneck_dim=16",
+    "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=4",
+    "optim.scaling_rule=none",
+]
+
+
+def _teacher_yaml(tmp_path, arch="vit_test_big", hidden=48):
+    recipe = {
+        "student": {"arch": arch, "patch_size": 4, "drop_path_rate": 0.0},
+        "dino": {"head_n_prototypes": 64, "head_hidden_dim": hidden,
+                 "head_bottleneck_dim": 16},
+        "ibot": {"head_n_prototypes": 64, "head_hidden_dim": hidden,
+                 "head_bottleneck_dim": 16},
+        "crops": {"global_crops_size": 16, "local_crops_size": 8,
+                  "local_crops_number": 2},
+        "optim": {"scaling_rule": "none"},
+    }
+    path = tmp_path / "teacher.yaml"
+    path.write_text(yaml.safe_dump(recipe))
+    return str(path)
+
+
+def test_distillation_step_with_frozen_bigger_teacher(tmp_path):
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, SMOL + [
+        "student.arch=vit_test",
+        "optim.warmup_epochs=0",  # lr > 0 at step 0 so the student moves
+        "distillation.enabled=true",
+        f"distillation.full_cfg_path={_teacher_yaml(tmp_path)}",
+    ])
+    from dinov3_tpu.train import build_train_setup, put_batch
+
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, 4, seed=0).items()}
+    setup = build_train_setup(cfg, batch)
+    # teacher backbone is the bigger arch
+    assert setup.meta.teacher_embed_dim == 96
+    assert setup.meta.embed_dim == 64
+    teacher_before = jax.tree.map(
+        np.asarray, setup.state.params["teacher"])
+    student_before = np.asarray(
+        jax.tree.leaves(setup.state.params["student"])[0])
+
+    dbatch = put_batch(batch, setup.batch_shardings)
+    state, metrics = setup.step_fn(
+        setup.state, dbatch, setup.scalars(0), jax.random.key(0)
+    )
+    assert jnp.isfinite(metrics["total_loss"])
+    # frozen teacher: unchanged after the step
+    teacher_after = jax.tree.map(np.asarray, state.params["teacher"])
+    for a, b in zip(jax.tree.leaves(teacher_before),
+                    jax.tree.leaves(teacher_after)):
+        assert np.array_equal(a, b)
+    # student did move
+    s1 = jax.tree.leaves(state.params["student"])[0]
+    assert not np.allclose(student_before, np.asarray(s1))
+
+
+def test_distillation_prototype_mismatch_rejected(tmp_path):
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, SMOL + [
+        "student.arch=vit_test",
+        "dino.head_n_prototypes=128",
+        "distillation.enabled=true",
+        f"distillation.full_cfg_path={_teacher_yaml(tmp_path)}",
+    ])
+    from dinov3_tpu.train.ssl_meta_arch import SSLMetaArch
+
+    with pytest.raises(ValueError, match="head_n_prototypes"):
+        SSLMetaArch(cfg)
+
+
+def test_load_teacher_params_from_checkpoint(tmp_path):
+    """Pretrain a tiny teacher, checkpoint it, then restore it as the
+    frozen teacher of a distillation run."""
+    from dinov3_tpu.checkpoint import Checkpointer
+    from dinov3_tpu.train import build_train_setup, put_batch
+    from dinov3_tpu.train.distillation import load_teacher_params
+
+    # 1) teacher pretrain run (vit_test_big as its own student)
+    t_cfg = get_default_config()
+    apply_dot_overrides(t_cfg, SMOL + [
+        "student.arch=vit_test_big",
+        "dino.head_hidden_dim=48", "ibot.head_hidden_dim=48",
+    ])
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(t_cfg, 4, seed=0).items()}
+    t_setup = build_train_setup(t_cfg, batch)
+    t_state, _ = t_setup.step_fn(
+        t_setup.state, put_batch(batch, t_setup.batch_shardings),
+        t_setup.scalars(0), jax.random.key(0),
+    )
+    ckpt_dir = str(tmp_path / "teacher_ckpt")
+    ckpt = Checkpointer(ckpt_dir, async_save=False)
+    ckpt.save(1, t_state)
+    ckpt.close()
+
+    # 2) distillation run restoring that teacher
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, SMOL + [
+        "student.arch=vit_test",
+        "distillation.enabled=true",
+        f"distillation.full_cfg_path={_teacher_yaml(tmp_path, hidden=48)}",
+        f"distillation.checkpoint_path={ckpt_dir}",
+    ])
+    setup = build_train_setup(cfg, batch)
+    state = load_teacher_params(cfg, setup.state, setup.state_shardings)
+    want = jax.tree.leaves(t_state.params["teacher"])
+    got = jax.tree.leaves(state.params["teacher"])
+    assert len(want) == len(got)
+    for w, g in zip(want, got):
+        assert np.allclose(np.asarray(w), np.asarray(g))
+
+
+# ------------------------------------------------------ multidistillation
+
+
+def test_enumerate_subgroup_ranks():
+    assert enumerate_subgroup_ranks([(0, 2), (2, 3)]) == ((0, 1), (2,))
+    with pytest.raises(ValueError):
+        enumerate_subgroup_ranks([(3, 3)])
+
+
+def test_setup_multidistillation_assignment(tmp_path):
+    student_yaml = tmp_path / "vits.yaml"
+    student_yaml.write_text(yaml.safe_dump({
+        "student": {"arch": "vit_test", "patch_size": 4},
+        "optim": {"scaling_rule": "none"},
+    }))
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        "multidistillation.enabled=true",
+        "multidistillation.global_batch_size=8",
+    ])
+    cfg.multidistillation.students = [
+        {"name": "a", "config_path": str(student_yaml), "ranks_range": [0, 2]},
+        {"name": "b", "config_path": str(student_yaml), "ranks_range": [2, 4]},
+    ]
+    got = {}
+    for rank in range(4):
+        a = setup_multidistillation(
+            cfg, rank, 4, base_output_dir=str(tmp_path / "out"))
+        got[rank] = (a.name, a.group_rank)
+        assert a.cfg.train.batch_size_per_device == 2
+        assert a.cfg.student.arch == "vit_test"
+        assert a.output_dir.endswith(a.name)
+    assert got == {0: ("a", 0), 1: ("a", 1), 2: ("b", 0), 3: ("b", 1)}
+
+    cfg.multidistillation.students[1]["ranks_range"] = [2, 5]
+    with pytest.raises(ValueError, match="partition"):
+        setup_multidistillation(cfg, 0, 4, base_output_dir=str(tmp_path))
