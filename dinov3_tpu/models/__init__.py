@@ -8,6 +8,11 @@
 from __future__ import annotations
 
 from dinov3_tpu.configs import ConfigNode
+from dinov3_tpu.models.convnext import (
+    CONVNEXT_SIZES,
+    ConvNeXt,
+    get_convnext_arch,
+)
 from dinov3_tpu.models.vision_transformer import (
     ARCHS,
     DinoVisionTransformer,
@@ -67,8 +72,17 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
     return kw
 
 
-def build_backbone(cfg: ConfigNode, *, teacher: bool = False) -> DinoVisionTransformer:
+def build_backbone(cfg: ConfigNode, *, teacher: bool = False):
     arch = cfg.student.arch
+    if arch.startswith("convnext"):
+        from dinov3_tpu.models.convnext import (
+            convnext_kwargs_from_cfg,
+            get_convnext_arch,
+        )
+
+        return get_convnext_arch(arch)(
+            **convnext_kwargs_from_cfg(cfg, teacher=teacher)
+        )
     if arch not in ARCHS:
         raise ValueError(f"unknown arch {arch!r}; available: {sorted(ARCHS)}")
     return ARCHS[arch](**backbone_kwargs_from_cfg(cfg, teacher=teacher))

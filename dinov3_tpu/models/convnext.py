@@ -1,0 +1,265 @@
+"""ConvNeXt backbone, NHWC / bf16 / MXU-friendly.
+
+(reference: dinov3_jax/models/convnext.py — dead code in the reference
+tree: never imported by its factory (models/__init__.py:12), a syntax
+error in ``forward_features_list`` (:227) and a hard ``raise`` in
+``Block.__call__`` (:83) (SURVEY.md §2.2). Re-implemented here as a live
+backbone with the same architecture table (tiny/small/base/large,
+:303-321) and the same DINO adaptations: mean-pool pseudo-CLS token, a
+shared final norm over [cls | patches], and a ``patch_size`` option that
+bilinearly resizes the stage-4 feature map onto a ViT-p patch grid so
+ConvNeXt students can sit in the same SSL meta-arch (:210-235).
+
+TPU-first choices: channels-last everywhere (stem + downsample convs lower
+to MXU matmuls), depthwise 7x7 stays a VPU-friendly ``feature_group_count``
+conv, LayerNorm statistics in fp32, stochastic depth as per-sample masks.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.common import Policy, part, trunc_normal_init
+from dinov3_tpu.ops.drop_path import DropPath
+from dinov3_tpu.ops.norms import LayerNorm
+
+
+class ConvNeXtBlock(nn.Module):
+    dim: int
+    drop_path_rate: float = 0.0
+    layer_scale_init: float | None = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    reduce_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True):
+        # x: [B, H, W, C]
+        residual = x
+        x = nn.Conv(
+            self.dim, kernel_size=(7, 7), padding="SAME",
+            feature_group_count=self.dim, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), (None, None, None, "embed")),
+            name="dwconv",
+        )(x.astype(self.dtype))
+        x = LayerNorm(
+            param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
+            name="norm",
+        )(x)
+        x = nn.Dense(
+            4 * self.dim, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), ("embed", "mlp")),
+            name="pwconv1",
+        )(x.astype(self.dtype))
+        x = nn.gelu(x)
+        x = nn.Dense(
+            self.dim, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), ("mlp", "embed")),
+            name="pwconv2",
+        )(x)
+        if self.layer_scale_init is not None:
+            gamma = self.param(
+                "gamma", part(nn.initializers.constant(self.layer_scale_init),
+                              ("embed",)),
+                (self.dim,), self.param_dtype,
+            )
+            x = x * gamma.astype(x.dtype)
+        x = DropPath(self.drop_path_rate)(x, deterministic=deterministic)
+        return residual + x
+
+
+class ConvNeXt(nn.Module):
+    depths: Sequence[int] = (3, 3, 9, 3)
+    dims: Sequence[int] = (96, 192, 384, 768)
+    drop_path_rate: float = 0.0
+    layer_scale_init: float | None = 1e-6
+    in_chans: int = 3
+    # DINO adaptation: resize final features onto a ViT-style patch grid
+    patch_size: int | None = None
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    reduce_dtype: Any = jnp.float32
+
+    @property
+    def embed_dim(self) -> int:
+        return self.dims[-1]
+
+    @property
+    def n_storage_tokens(self) -> int:
+        return 0
+
+    def _downsample(self, x, i: int):
+        norm_kw = dict(param_dtype=self.param_dtype,
+                       reduce_dtype=self.reduce_dtype)
+        if i == 0:
+            x = nn.Conv(
+                self.dims[0], kernel_size=(4, 4), strides=(4, 4),
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                kernel_init=part(trunc_normal_init(),
+                                 (None, None, None, "embed")),
+                name="stem_conv",
+            )(x.astype(self.dtype))
+            return LayerNorm(name="stem_norm", **norm_kw)(x)
+        x = LayerNorm(name=f"down{i}_norm", **norm_kw)(x)
+        return nn.Conv(
+            self.dims[i], kernel_size=(2, 2), strides=(2, 2),
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), (None, None, None, "embed")),
+            name=f"down{i}_conv",
+        )(x.astype(self.dtype))
+
+    def _stage(self, x, i: int, dp_rates, deterministic):
+        start = sum(self.depths[:i])
+        for j in range(self.depths[i]):
+            x = ConvNeXtBlock(
+                dim=self.dims[i],
+                drop_path_rate=float(dp_rates[start + j]),
+                layer_scale_init=self.layer_scale_init,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                reduce_dtype=self.reduce_dtype,
+                name=f"stage{i}_block{j}",
+            )(x, deterministic=deterministic)
+        return x
+
+    def _dp_rates(self):
+        total = sum(self.depths)
+        if total <= 1 or self.drop_path_rate == 0.0:
+            return [0.0] * total
+        return [self.drop_path_rate * k / (total - 1) for k in range(total)]
+
+    def _features(self, x, deterministic, collect: Sequence[int] = ()):
+        dp_rates = self._dp_rates()
+        collected = {}
+        for i in range(4):
+            x = self._downsample(x, i)
+            x = self._stage(x, i, dp_rates, deterministic)
+            if i in collect:
+                collected[i] = x
+        return x, collected
+
+    def _pseudo_patch_grid(self, feats, h, w):
+        """Resize [B, H/32, W/32, C] onto the ViT patch grid H/p x W/p
+        (reference convnext.py:253-259)."""
+        if self.patch_size is None:
+            return feats
+        hp, wp = h // self.patch_size, w // self.patch_size
+        if feats.shape[1:3] == (hp, wp):
+            return feats
+        return jax.image.resize(
+            feats, (feats.shape[0], hp, wp, feats.shape[-1]),
+            method="bilinear",
+        ).astype(feats.dtype)
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        masks: jnp.ndarray | None = None,
+        *,
+        crop_kind: str = "global",
+        deterministic: bool = True,
+    ) -> dict:
+        """Same output contract as DinoVisionTransformer. ``masks`` is
+        carried through for API parity; a convnet cannot mask tokens
+        mid-stage (iBOT applies to ViT students only, as in the original
+        DINOv3)."""
+        B, H, W, _ = x.shape
+        feats, _ = self._features(x, deterministic)
+        feats = self._pseudo_patch_grid(feats, H, W)
+        pooled = feats.mean(axis=(1, 2))  # [B, C] pseudo-CLS
+        tokens = feats.reshape(B, -1, feats.shape[-1])
+        norm = LayerNorm(
+            param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
+            name="norm",
+        )
+        x_norm = norm(jnp.concatenate([pooled[:, None, :], tokens], axis=1))
+        return {
+            "x_norm_clstoken": x_norm[:, 0],
+            "x_storage_tokens": x_norm[:, 1:1],
+            "x_norm_patchtokens": x_norm[:, 1:],
+            "x_prenorm": tokens,
+            "masks": masks,
+        }
+
+    @nn.compact
+    def get_intermediate_layers(
+        self,
+        x: jnp.ndarray,
+        n: int | Sequence[int] = 1,
+        reshape: bool = False,
+        return_class_token: bool = False,
+        norm: bool = True,
+    ):
+        """(reference convnext.py:269-301; only the final stage has a
+        trained norm — earlier stages return raw features, as there.)"""
+        B, H, W, _ = x.shape
+        take = (
+            list(range(4 - n, 4)) if isinstance(n, int) else [int(i) for i in n]
+        )
+        _, collected = self._features(x, True, collect=take)
+        outputs = []
+        for i in take:
+            feats = collected[i]
+            if i == 3:
+                feats = self._pseudo_patch_grid(feats, H, W)
+            pooled = feats.mean(axis=(1, 2))
+            tokens = feats.reshape(B, -1, feats.shape[-1])
+            if norm and i == 3:
+                normed = LayerNorm(
+                    param_dtype=self.param_dtype,
+                    reduce_dtype=self.reduce_dtype, name="norm",
+                )(jnp.concatenate([pooled[:, None, :], tokens], axis=1))
+                pooled, tokens = normed[:, 0], normed[:, 1:]
+            if reshape:
+                hh, ww = feats.shape[1:3]
+                tokens = tokens.reshape(B, hh, ww, -1)
+            outputs.append(
+                (tokens, pooled) if return_class_token else tokens
+            )
+        return tuple(outputs)
+
+
+# architecture table (reference convnext.py:303-321)
+CONVNEXT_SIZES = {
+    "tiny": dict(depths=(3, 3, 9, 3), dims=(96, 192, 384, 768)),
+    "small": dict(depths=(3, 3, 27, 3), dims=(96, 192, 384, 768)),
+    "base": dict(depths=(3, 3, 27, 3), dims=(128, 256, 512, 1024)),
+    "large": dict(depths=(3, 3, 27, 3), dims=(192, 384, 768, 1536)),
+    "test": dict(depths=(1, 1, 2, 1), dims=(8, 16, 32, 64)),
+}
+
+
+def get_convnext_arch(arch_name: str):
+    """"convnext_tiny" -> constructor (reference convnext.py:324-334)."""
+    size = arch_name.split("_", 1)[1]
+    if size not in CONVNEXT_SIZES:
+        raise ValueError(
+            f"unknown convnext size {size!r} (have {sorted(CONVNEXT_SIZES)})"
+        )
+    table = CONVNEXT_SIZES[size]
+
+    def ctor(**kwargs):
+        args = dict(table)
+        args.update(kwargs)
+        return ConvNeXt(**args)
+
+    return ctor
+
+
+def convnext_kwargs_from_cfg(cfg, *, teacher: bool = False) -> dict:
+    s = cfg.student
+    policy = Policy.from_cfg(cfg.compute_precision)
+    return dict(
+        drop_path_rate=0.0 if teacher else s.drop_path_rate,
+        layer_scale_init=s.layerscale,
+        in_chans=s.in_chans,
+        patch_size=s.patch_size,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        reduce_dtype=policy.reduce_dtype,
+    )
