@@ -24,11 +24,15 @@ class SyntheticImages(ExtendedVisionDataset):
         size: int = 10_000,
         image_size: int = 256,
         n_classes: int = 1000,
+        split: str = "TRAIN",  # accepted for dataset-string compatibility
         transform: Optional[Callable] = None,
         target_transform: Optional[Callable] = None,
         seed: int = 0,
     ):
         super().__init__(transform, target_transform, seed)
+        # distinct splits draw from distinct index universes
+        seed_offset = {"TRAIN": 0, "VAL": 1, "TEST": 2}.get(str(split).upper(), 0)
+        self.seed = seed * 4 + seed_offset
         self.size = int(size)
         self.image_size = int(image_size)
         self.n_classes = int(n_classes)

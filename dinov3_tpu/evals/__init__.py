@@ -1,0 +1,9 @@
+from dinov3_tpu.evals.features import extract_features
+from dinov3_tpu.evals.knn import knn_classify, knn_eval
+from dinov3_tpu.evals.linear import linear_probe_eval
+from dinov3_tpu.evals.harness import do_eval
+
+__all__ = [
+    "extract_features", "knn_classify", "knn_eval", "linear_probe_eval",
+    "do_eval",
+]

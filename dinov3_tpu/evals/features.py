@@ -1,0 +1,52 @@
+"""Batched backbone feature extraction for evals.
+
+(reference: absent — dinov3_jax's ``do_test`` raised ``NotImplemented``
+(train/train.py:315-316) and its eval-model builder imported nonexistent
+``dinov3.*`` modules (models/__init__.py:81-93, SURVEY.md §2.2). This is
+the working harness: one jitted forward per (batch-shape), features
+gathered to host as float32.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_feature_fn(model, params) -> Callable:
+    """Jitted [B, H, W, 3] -> [B, D] CLS-feature function."""
+
+    @jax.jit
+    def feat(x):
+        out = model.apply(
+            {"params": params} if "params" not in params else params,
+            x, crop_kind="global", deterministic=True,
+        )
+        return out["x_norm_clstoken"].astype(jnp.float32)
+
+    return feat
+
+
+def extract_features(
+    model,
+    params,
+    batches: Iterator[dict],
+    max_batches: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """batches: dicts with "image" [B, H, W, 3] and "label" [B].
+
+    Returns (features [N, D] f32, labels [N] i64) on host.
+    """
+    feat = make_feature_fn(model, params)
+    feats, labels = [], []
+    for i, batch in enumerate(batches):
+        if max_batches is not None and i >= max_batches:
+            break
+        feats.append(np.asarray(feat(jnp.asarray(batch["image"]))))
+        labels.append(np.asarray(batch["label"]))
+    if not feats:
+        raise ValueError("no batches to extract features from")
+    return np.concatenate(feats), np.concatenate(labels)

@@ -1,0 +1,105 @@
+"""In-training evaluation: k-NN + linear probe on the EMA teacher.
+
+The working replacement for the reference's ``do_test`` stub
+(dinov3_jax/train/train.py:315-316) wired to
+``evaluation.eval_period_iterations`` (ssl_default_config.yaml).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from dinov3_tpu.data.collate import collate_eval
+from dinov3_tpu.data.loaders import SamplerType, make_data_loader, make_dataset
+from dinov3_tpu.data.transforms import (
+    make_classification_eval_transform,
+    make_classification_train_transform,
+)
+from dinov3_tpu.evals.features import extract_features
+from dinov3_tpu.evals.knn import knn_eval
+from dinov3_tpu.evals.linear import linear_probe_eval
+
+logger = logging.getLogger("dinov3")
+
+
+def _loader(dataset_str, transform, batch_size, num_workers, seed, max_samples):
+    def wrap(samples):
+        return collate_eval(
+            [{"image": img, "label": t} for img, t in samples]
+        )
+
+    ds = make_dataset(dataset_str, transform=transform, seed=seed)
+    n = len(ds)
+    loader = make_data_loader(
+        ds, batch_size=batch_size, collate_fn=wrap,
+        num_workers=num_workers, shuffle=True, seed=seed,
+        sampler_type=SamplerType.EPOCH, drop_last=True,
+    )
+    max_batches = max(1, min(n, max_samples) // batch_size)
+    return loader, max_batches
+
+
+def do_eval(
+    cfg,
+    model,
+    teacher_backbone_params,
+    *,
+    train_dataset_str: str | None = None,
+    val_dataset_str: str | None = None,
+    n_classes: int = 1000,
+    batch_size: int = 64,
+    max_train_samples: int = 10_000,
+    max_val_samples: int = 2_000,
+    knn_k: int = 10,
+    probe_epochs: int = 10,
+) -> dict:
+    """Returns {"knn_top1": .., "linear_top1": ..} for the given backbone
+    params (normally the EMA teacher's)."""
+    ev = cfg.get("evaluation") or {}
+    train_str = train_dataset_str or ev.get("train_dataset_path") or \
+        cfg.train.dataset_path
+    val_str = val_dataset_str or ev.get("val_dataset_path") or train_str
+    size = cfg.crops.global_crops_size
+    num_workers = cfg.train.get("num_workers", 8)
+
+    train_loader, train_batches = _loader(
+        train_str,
+        make_classification_train_transform(crop_size=size),
+        batch_size, num_workers, cfg.train.seed, max_train_samples,
+    )
+    val_loader, val_batches = _loader(
+        val_str,
+        make_classification_eval_transform(
+            resize_size=int(size * 256 / 224), crop_size=size),
+        batch_size, num_workers, cfg.train.seed + 1, max_val_samples,
+    )
+
+    train_feats, train_labels = extract_features(
+        model, {"params": teacher_backbone_params}, iter(train_loader),
+        max_batches=train_batches,
+    )
+    val_feats, val_labels = extract_features(
+        model, {"params": teacher_backbone_params}, iter(val_loader),
+        max_batches=val_batches,
+    )
+    n_classes = int(
+        max(n_classes, train_labels.max() + 1, val_labels.max() + 1)
+    )
+    results = {
+        "knn_top1": knn_eval(
+            train_feats, train_labels, val_feats, val_labels,
+            n_classes, k=knn_k,
+        ),
+        "linear_top1": linear_probe_eval(
+            train_feats, train_labels, val_feats, val_labels,
+            n_classes, epochs=probe_epochs,
+        ),
+    }
+    logger.info(
+        "eval: knn_top1=%.4f linear_top1=%.4f (%d train / %d val feats)",
+        results["knn_top1"], results["linear_top1"],
+        len(train_feats), len(val_feats),
+    )
+    return results

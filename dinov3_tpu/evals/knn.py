@@ -1,0 +1,61 @@
+"""Weighted k-NN classification (the DINO evaluation protocol).
+
+Cosine similarity on L2-normalized features, votes weighted by
+``exp(sim / T)`` with T = 0.07, k = 10/20 — the protocol behind the
+reference's headline "IN-1k k-NN top-1 82.2%" number
+(SURVEY.md §6; recipe comments in
+dinov3_jax/configs/train/vitl_im1k_lin834.yaml:1-4).
+
+Runs on device in score-chunks so the [N_test, N_train] similarity matrix
+never materializes whole.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _normalize(x: jnp.ndarray) -> jnp.ndarray:
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+
+
+def knn_classify(
+    train_feats: np.ndarray,
+    train_labels: np.ndarray,
+    test_feats: np.ndarray,
+    n_classes: int,
+    k: int = 10,
+    temperature: float = 0.07,
+    chunk: int = 1024,
+) -> np.ndarray:
+    """Predicted labels [N_test]."""
+    tr = _normalize(jnp.asarray(train_feats, jnp.float32))
+    labels = jnp.asarray(train_labels, jnp.int32)
+    k = min(k, tr.shape[0])
+
+    @jax.jit
+    def score_chunk(q):
+        sims = _normalize(q) @ tr.T  # [C, N_train]
+        top_sims, top_idx = jax.lax.top_k(sims, k)
+        votes = jax.nn.one_hot(labels[top_idx], n_classes)  # [C, k, K]
+        weights = jnp.exp(top_sims / temperature)[..., None]
+        return jnp.argmax(jnp.sum(votes * weights, axis=1), axis=-1)
+
+    preds = []
+    te = jnp.asarray(test_feats, jnp.float32)
+    for start in range(0, te.shape[0], chunk):
+        preds.append(np.asarray(score_chunk(te[start: start + chunk])))
+    return np.concatenate(preds)
+
+
+def knn_eval(
+    train_feats, train_labels, test_feats, test_labels,
+    n_classes: int, k: int = 10, temperature: float = 0.07,
+) -> float:
+    """Top-1 accuracy."""
+    preds = knn_classify(
+        train_feats, train_labels, test_feats, n_classes, k, temperature
+    )
+    return float((preds == np.asarray(test_labels)).mean())

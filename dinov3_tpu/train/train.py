@@ -156,6 +156,15 @@ def do_train(cfg, args) -> dict:
         if prof and it == prof[1]:
             jax.tree.leaves(state.params)[0].block_until_ready()
             jax.profiler.stop_trace()
+        eval_period = cfg.evaluation.get("eval_period_iterations", 0)
+        if eval_period and (it + 1) % eval_period == 0:
+            from dinov3_tpu.evals import do_eval
+
+            results = do_eval(
+                cfg, setup.meta.teacher_backbone,
+                state.params["teacher"]["backbone"],
+            )
+            metric_logger.update(**results)
         if (it + 1) % cfg.checkpointing.period == 0 or it + 1 == total_iters:
             ckpt.save(it + 1, state)
         if it + 1 >= total_iters:
