@@ -1,0 +1,6 @@
+from dinov3_tpu.interop.torch_convert import (
+    convert_torch_backbone_state_dict,
+    load_backbone_from_torch,
+)
+
+__all__ = ["convert_torch_backbone_state_dict", "load_backbone_from_torch"]

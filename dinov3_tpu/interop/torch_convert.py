@@ -1,0 +1,166 @@
+"""Meta DINOv3 (PyTorch) checkpoint -> dinov3_tpu parameter tree.
+
+(reference: hubconf.py:40-76 — remapped torch.hub ``dinov3_vits16``
+weights into the Flax tree at import time: kernel transposes,
+``fcN -> Dense_{N-1}``, ``blocks. -> blocks_``, rope periods into
+"constants". Here the conversion is an explicit, tested function keyed to
+THIS framework's parameter names, with shape validation against an
+abstract init instead of silent mismatches.)
+
+Key mapping (Meta torch name -> ours):
+    cls_token                      cls_token                 [1, 1, D]
+    storage_tokens                 storage_tokens            [1, S, D]
+    mask_token                     mask_token                [1, D] -> [D]
+    patch_embed.proj.weight        patch_embed.kernel        [D,3,p,p] -> [p,p,3,D]
+    patch_embed.proj.bias          patch_embed.bias
+    blocks.N.norm1.weight/.bias    blocks_N.norm1.scale/.bias
+    blocks.N.attn.qkv.weight       blocks_N.attn.qkv_kernel  [3D, D] -> [D, 3D]
+    blocks.N.attn.qkv.bias         blocks_N.attn.qkv_bias
+    blocks.N.attn.proj.weight      blocks_N.attn.proj_kernel (transposed)
+    blocks.N.attn.proj.bias        blocks_N.attn.proj_bias
+    blocks.N.ls1.gamma / ls2.gamma blocks_N.ls1.gamma / ls2.gamma
+    blocks.N.mlp.fc1/.fc2          blocks_N.mlp.fc1/.fc2     (kernels transposed)
+    blocks.N.mlp.w1/.w2/.w3        blocks_N.mlp.w1/.w2/.w3   (SwiGLU, transposed)
+    norm.weight/.bias              norm.scale/.bias
+RoPE has no parameters on either side (periods are recomputed from
+config); ``rope_embed.*`` buffers and ``*.bias_mask`` entries are skipped
+(the k-bias mask is a constant 0/1 mask in this framework).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+_SKIP_PATTERNS = (
+    re.compile(r"^rope_embed\."),
+    re.compile(r"\.bias_mask$"),
+    re.compile(r"^local_cls_norm\."),  # handled below if the target has it
+)
+
+
+def _to_numpy(v: Any) -> np.ndarray:
+    if hasattr(v, "detach"):  # torch tensor
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def _map_key(tk: str) -> tuple[str | None, bool]:
+    """torch key -> (ours as a .-path, transpose?)."""
+    for pat in _SKIP_PATTERNS[:2]:
+        if pat.search(tk):
+            return None, False
+    jk = tk
+    transpose = False
+    parts = jk.split(".")
+    if parts[-1] == "weight":
+        parent = parts[-2] if len(parts) > 1 else ""
+        if "norm" in parent:
+            parts[-1] = "scale"
+        elif parent == "proj" and parts[0] == "patch_embed":
+            parts = ["patch_embed", "kernel"]  # conv: permuted, not transposed
+        else:
+            parts[-1] = "kernel"
+            transpose = True
+        if parent == "qkv":
+            parts = parts[:-2] + ["qkv_kernel"]
+        elif parent == "proj" and "attn" in parts:
+            parts = parts[:-2] + ["proj_kernel"]
+    elif parts[-1] == "bias":
+        parent = parts[-2] if len(parts) > 1 else ""
+        if parent == "qkv":
+            parts = parts[:-2] + ["qkv_bias"]
+        elif parent == "proj" and "attn" in parts:
+            parts = parts[:-2] + ["proj_bias"]
+        elif parent == "proj" and parts[0] == "patch_embed":
+            parts = ["patch_embed", "bias"]
+    jk = ".".join(parts)
+    jk = re.sub(r"^blocks\.(\d+)\.", r"blocks_\1.", jk)
+    # Meta names the untied norms cls_norm / patch_norm like we do
+    jk = jk.replace("local_cls_norm", "local_cls_norm")
+    return jk, transpose
+
+
+def convert_torch_backbone_state_dict(
+    state_dict: Mapping[str, Any],
+    dtype=jnp.float32,
+) -> dict:
+    """Flat {\"a.b.c\": array} -> nested params dict in our layout."""
+    flat: dict[str, np.ndarray] = {}
+    for tk, tv in state_dict.items():
+        jk, transpose = _map_key(tk)
+        if jk is None:
+            continue
+        v = _to_numpy(tv)
+        if jk == "patch_embed.kernel":
+            v = v.transpose(2, 3, 1, 0)  # [D,3,p,p] -> [p,p,3,D]
+        elif jk == "mask_token":
+            v = v.reshape(-1)
+        elif transpose:
+            v = v.T
+        flat[jk] = v.astype(jnp.dtype(dtype))
+    nested: dict = {}
+    for key, v in flat.items():
+        node = nested
+        *path, leaf = key.split(".")
+        for p in path:
+            node = node.setdefault(p, {})
+        node[leaf] = jnp.asarray(v)
+    return nested
+
+
+def _tree_paths(tree: Mapping, prefix=()) -> dict[tuple, Any]:
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, Mapping):
+            out.update(_tree_paths(v, prefix + (k,)))
+        else:
+            out[prefix + (k,)] = v
+    return out
+
+
+def load_backbone_from_torch(
+    model,
+    state_dict: Mapping[str, Any],
+    example_shape: tuple = (1, 224, 224, 3),
+    strict: bool = True,
+) -> dict:
+    """Returns ``{"params": ...}`` validated against the model's own
+    abstract init (shape check per leaf, missing/unexpected reported)."""
+    import jax
+
+    abstract = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros(example_shape, jnp.float32)),
+        jax.random.key(0),
+    )
+    import flax.linen as nn
+
+    target = _tree_paths(nn.meta.unbox(abstract)["params"])
+    got = _tree_paths(convert_torch_backbone_state_dict(state_dict))
+
+    missing = sorted(set(target) - set(got))
+    unexpected = sorted(set(got) - set(target))
+    mismatched = sorted(
+        p for p in set(target) & set(got)
+        if tuple(target[p].shape) != tuple(got[p].shape)
+    )
+    if strict and (missing or unexpected or mismatched):
+        def fmt(paths):
+            return [".".join(p) for p in paths[:8]]
+
+        raise ValueError(
+            f"torch->jax conversion mismatch: missing={fmt(missing)} "
+            f"unexpected={fmt(unexpected)} shape-mismatch={fmt(mismatched)}"
+        )
+    params: dict = {}
+    for p, v in got.items():
+        if p not in target:
+            continue
+        node = params
+        for k in p[:-1]:
+            node = node.setdefault(k, {})
+        node[p[-1]] = v
+    return {"params": params}

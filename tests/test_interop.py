@@ -1,0 +1,114 @@
+"""Torch-checkpoint conversion: build a Meta-layout state_dict from a real
+init, convert it back, and verify numerical forward equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinov3_tpu.interop import (
+    convert_torch_backbone_state_dict,
+    load_backbone_from_torch,
+)
+from dinov3_tpu.models.vision_transformer import vit_test
+
+
+def _fake_torch_sd_from_params(params: dict) -> dict:
+    """Inverse of the converter: our tree -> Meta torch key layout."""
+    sd = {}
+
+    def walk(node, path):
+        for k, v in node.items():
+            p = path + [k]
+            if isinstance(v, dict):
+                walk(v, p)
+                continue
+            v = np.asarray(v)
+            key = ".".join(p)
+            key = key.replace("blocks_", "blocks.")
+            if key == "patch_embed.kernel":
+                sd["patch_embed.proj.weight"] = v.transpose(3, 2, 0, 1)
+            elif key == "patch_embed.bias":
+                sd["patch_embed.proj.bias"] = v
+            elif key == "mask_token":
+                sd["mask_token"] = v.reshape(1, -1)
+            elif key.endswith("attn.qkv_kernel"):
+                sd[key.replace("qkv_kernel", "qkv.weight")] = v.T
+            elif key.endswith("attn.qkv_bias"):
+                sd[key.replace("qkv_bias", "qkv.bias")] = v
+            elif key.endswith("attn.proj_kernel"):
+                sd[key.replace("proj_kernel", "proj.weight")] = v.T
+            elif key.endswith("attn.proj_bias"):
+                sd[key.replace("proj_bias", "proj.bias")] = v
+            elif key.endswith(".scale"):
+                sd[key[: -len(".scale")] + ".weight"] = v
+            elif key.endswith(".kernel"):
+                sd[key[: -len(".kernel")] + ".weight"] = v.T
+            else:
+                sd[key] = v
+
+    walk(params, [])
+    # buffers the converter must skip
+    sd["rope_embed.periods"] = np.ones(4, np.float32)
+    return sd
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = vit_test(patch_size=4, n_storage_tokens=4, drop_path_rate=0.0)
+    import flax.linen as nn
+
+    x = jnp.zeros((1, 16, 16, 3))
+    variables = nn.meta.unbox(model.init(jax.random.key(1), x))
+    # give params non-trivial values so equivalence is meaningful
+    leaves, treedef = jax.tree.flatten(variables["params"])
+    rng = np.random.default_rng(0)
+    leaves = [
+        jnp.asarray(rng.standard_normal(l.shape), jnp.float32) * 0.05
+        for l in leaves
+    ]
+    params = jax.tree.unflatten(treedef, leaves)
+    return model, params
+
+
+def test_roundtrip_forward_equivalence(model_and_params):
+    model, params = model_and_params
+    sd = _fake_torch_sd_from_params(params)
+    restored = load_backbone_from_torch(
+        model, sd, example_shape=(1, 16, 16, 3)
+    )
+    x = jax.random.normal(jax.random.key(2), (2, 16, 16, 3))
+    want = model.apply({"params": params}, x, deterministic=True)
+    got = model.apply(restored, x, deterministic=True)
+    assert np.allclose(
+        np.asarray(want["x_norm_clstoken"], np.float32),
+        np.asarray(got["x_norm_clstoken"], np.float32),
+        atol=1e-6,
+    )
+    assert np.allclose(
+        np.asarray(want["x_norm_patchtokens"], np.float32),
+        np.asarray(got["x_norm_patchtokens"], np.float32),
+        atol=1e-6,
+    )
+
+
+def test_strict_mode_reports_missing(model_and_params):
+    model, params = model_and_params
+    sd = _fake_torch_sd_from_params(params)
+    del sd["cls_token"]
+    sd["mystery.weight"] = np.zeros((3, 3), np.float32)
+    with pytest.raises(ValueError, match="missing"):
+        load_backbone_from_torch(model, sd, example_shape=(1, 16, 16, 3))
+    # non-strict drops the extras and keeps going
+    restored = load_backbone_from_torch(
+        model, sd, example_shape=(1, 16, 16, 3), strict=False
+    )
+    assert "cls_token" not in restored["params"]
+    assert "mystery" not in restored["params"]
+
+
+def test_convert_skips_buffers(model_and_params):
+    _, params = model_and_params
+    sd = _fake_torch_sd_from_params(params)
+    out = convert_torch_backbone_state_dict(sd)
+    assert "rope_embed" not in out
