@@ -112,21 +112,25 @@ def make_multires_train_pipeline(
     from dinov3_tpu.data.multires import CombineDataLoader
 
     crops = cfg.crops
-    sizes = crops.get("global_local_crop_size_pairs")
-    ratios = crops.get("crop_size_ratios")
-    if not sizes:
+    g_sizes = crops.global_crops_size
+    if not isinstance(g_sizes, (list, tuple)):
         return make_train_pipeline(cfg, global_batch_size, rank, world_size)
+    l_sizes = crops.local_crops_size
+    gram_sizes = crops.get("gram_teacher_crops_size") or [None] * len(g_sizes)
+    ratios = crops.get("global_local_crop_pairs_ratios")
+    if not isinstance(l_sizes, (list, tuple)) or len(l_sizes) != len(g_sizes):
+        raise ValueError("global/local crop size lists must have equal length")
     import copy
 
     loaders = []
-    for pair in sizes:
+    for g, l, gram in zip(g_sizes, l_sizes, gram_sizes):
         sub = copy.deepcopy(cfg)
-        sub.crops.global_crops_size = int(pair[0])
-        sub.crops.local_crops_size = int(pair[1])
-        if len(pair) > 2 and pair[2]:
-            sub.crops.gram_teacher_crops_size = int(pair[2])
+        sub.crops.global_crops_size = int(g)
+        sub.crops.local_crops_size = int(l)
+        sub.crops.gram_teacher_crops_size = int(gram) if gram else None
         loaders.append(
             make_train_pipeline(sub, global_batch_size, rank, world_size)
         )
-    ratios = list(ratios or [1.0] * len(loaders))
-    return iter(CombineDataLoader(loaders, ratios, seed=cfg.train.seed))
+    if not isinstance(ratios, (list, tuple)):
+        ratios = [1.0] * len(loaders)
+    return iter(CombineDataLoader(loaders, list(ratios), seed=cfg.train.seed))

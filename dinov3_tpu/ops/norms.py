@@ -57,7 +57,10 @@ class RMSNorm(nn.Module):
 
 
 def make_norm_layer(kind: str, **kwargs) -> nn.Module:
-    if kind in ("layernorm", "layer_norm", "ln"):
+    # "layernormbf16" (7B recipes) selected a bf16-computed LN in the
+    # PyTorch original; statistics stay fp32 here — strictly more accurate
+    # and free on TPU (the VPU upcasts anyway).
+    if kind in ("layernorm", "layer_norm", "ln", "layernormbf16"):
         return LayerNorm(**kwargs)
     if kind in ("rmsnorm", "rms_norm", "rms"):
         return RMSNorm(**kwargs)
