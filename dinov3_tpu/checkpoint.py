@@ -78,6 +78,31 @@ class Checkpointer:
     def wait_until_finished(self) -> None:
         self.manager.wait_until_finished()
 
+    def restore_params_only(
+        self, state_like: TrainState, step: int | None = None
+    ) -> TrainState:
+        """Restore only ``params`` (fresh optimizer/centers/step) — the
+        high-res-adapt / fine-tune entry (reference hrft.checkpoint_path,
+        ssl_default_config.yaml)."""
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        abstract = jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, state_like.params
+        )
+        restored = self.manager.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.PyTreeRestore(
+                    {"params": abstract}, partial_restore=True
+                )
+            ),
+        )
+        logger.info("restored params-only checkpoint at step %d", step)
+        return state_like._replace(params=restored["state"]["params"])
+
     def close(self) -> None:
         self.manager.wait_until_finished()
         self.manager.close()

@@ -1,0 +1,54 @@
+"""Gram-teacher refresh: periodically re-anchor the frozen gram backbone
+to the current EMA teacher.
+
+(reference: dinov3_jax/train/train.py:605-616 (resume accounting) and
+:668-680 (cadence check calling ``model.update_gradm()`` — itself a stub).
+Semantics preserved: first refresh at ``gram.it_first_update``, then every
+``gram.update_frequency`` iterations, at most ``gram.max_updates`` times,
+with the count reconstructed on resume.)
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+import jax
+
+logger = logging.getLogger("dinov3")
+
+
+def gram_updates_before(cfg, start_iter: int) -> int:
+    """How many refreshes already happened before ``start_iter`` (resume)."""
+    g = cfg.gram
+    if not (g.use_loss and g.rep_update and not g.ema_teacher):
+        return 0
+    if start_iter <= 0 or start_iter < g.it_first_update:
+        return 0
+    n = math.ceil((start_iter + 1 - g.it_first_update) / g.update_frequency)
+    if g.max_updates is not None:
+        n = min(n, g.max_updates)
+    return n
+
+
+def should_refresh_gram(cfg, iteration: int, n_done: int) -> bool:
+    """After finishing ``iteration`` (0-based), refresh?"""
+    g = cfg.gram
+    if not (g.use_loss and g.rep_update and not g.ema_teacher):
+        return False
+    it1 = iteration + 1
+    if it1 < g.it_first_update or it1 % g.update_frequency != 0:
+        return False
+    return g.max_updates is None or n_done < g.max_updates
+
+
+def refresh_gram(state):
+    """gram.backbone <- teacher.backbone (device-side copy, sharding kept)."""
+    new_params = dict(state.params)
+    new_params["gram"] = {
+        "backbone": jax.tree.map(
+            lambda t: t.copy(), state.params["teacher"]["backbone"]
+        )
+    }
+    logger.info("gram teacher refreshed from EMA teacher")
+    return state._replace(params=new_params)

@@ -104,6 +104,11 @@ def do_train(cfg, args) -> dict:
         from dinov3_tpu.train.distillation import load_teacher_params
 
         state = load_teacher_params(cfg, state, setup.state_shardings)
+    elif cfg.hrft.enabled and cfg.hrft.checkpoint_path:
+        hrft_ckpt = Checkpointer(cfg.hrft.checkpoint_path)
+        state = hrft_ckpt.restore_params_only(state)
+        hrft_ckpt.close()
+        logger.info("hrft: params loaded from %s", cfg.hrft.checkpoint_path)
 
     prof = None
     if args.profile_steps:
@@ -118,6 +123,14 @@ def do_train(cfg, args) -> dict:
     nan_streak = 0
     last_loss = math.nan
     header = "Train"
+
+    from dinov3_tpu.train.gram_refresh import (
+        gram_updates_before,
+        refresh_gram,
+        should_refresh_gram,
+    )
+
+    n_gram_updates = gram_updates_before(cfg, start_iter)
 
     batch0 = put_batch(first, setup.batch_shardings)
     pending = batch0
@@ -156,6 +169,11 @@ def do_train(cfg, args) -> dict:
         if prof and it == prof[1]:
             jax.tree.leaves(state.params)[0].block_until_ready()
             jax.profiler.stop_trace()
+        if "gram" in state.params and should_refresh_gram(
+            cfg, it, n_gram_updates
+        ):
+            state = refresh_gram(state)
+            n_gram_updates += 1
         eval_period = cfg.evaluation.get("eval_period_iterations", 0)
         if eval_period and (it + 1) % eval_period == 0:
             from dinov3_tpu.evals import do_eval

@@ -1,0 +1,101 @@
+"""Gram-teacher refresh cadence + params-only (hrft) checkpoint restore."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+from dinov3_tpu.data import make_synthetic_batch
+from dinov3_tpu.train.gram_refresh import (
+    gram_updates_before,
+    refresh_gram,
+    should_refresh_gram,
+)
+
+SMOL = [
+    "student.arch=vit_test", "student.patch_size=4",
+    "student.drop_path_rate=0.0",
+    "crops.global_crops_size=16", "crops.local_crops_size=8",
+    "crops.local_crops_number=2",
+    "dino.head_n_prototypes=64", "dino.head_hidden_dim=32",
+    "dino.head_bottleneck_dim=16",
+    "ibot.head_n_prototypes=64", "ibot.head_hidden_dim=32",
+    "ibot.head_bottleneck_dim=16",
+    "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=4",
+    "optim.scaling_rule=none",
+]
+
+
+def _gram_cfg(extra=()):
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, SMOL + [
+        "gram.use_loss=true", "gram.ema_teacher=false",
+        "gram.rep_update=true", "gram.update_frequency=2",
+        "gram.it_first_update=2", "gram.max_updates=2",
+        "crops.gram_teacher_crops_size=16",
+    ] + list(extra))
+    return cfg
+
+
+def test_refresh_cadence():
+    cfg = _gram_cfg()
+    # first refresh after finishing iteration 1 (it+1 == 2 == first_update)
+    assert not should_refresh_gram(cfg, 0, 0)
+    assert should_refresh_gram(cfg, 1, 0)
+    assert should_refresh_gram(cfg, 3, 1)
+    assert not should_refresh_gram(cfg, 5, 2)  # max_updates reached
+    assert gram_updates_before(cfg, 0) == 0
+    assert gram_updates_before(cfg, 3) == 1
+    assert gram_updates_before(cfg, 100) == 2  # clamped by max_updates
+
+
+def test_refresh_copies_teacher_into_gram():
+    cfg = _gram_cfg()
+    from dinov3_tpu.train import build_train_setup, put_batch
+
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, 4, seed=0).items()}
+    setup = build_train_setup(cfg, batch)
+    assert "gram" in setup.state.params
+    state, _ = setup.step_fn(
+        setup.state, put_batch(batch, setup.batch_shardings),
+        setup.scalars(0), jax.random.key(0),
+    )
+    # after a step the teacher EMA moved away from the gram init
+    t_leaf = jax.tree.leaves(state.params["teacher"]["backbone"])[1]
+    g_leaf = jax.tree.leaves(state.params["gram"]["backbone"])[1]
+    state2 = refresh_gram(state)
+    g2 = jax.tree.leaves(state2.params["gram"]["backbone"])[1]
+    assert np.allclose(np.asarray(g2), np.asarray(t_leaf))
+    # and the copy is a new buffer, not an alias
+    assert state2.params["gram"]["backbone"] is not \
+        state2.params["teacher"]["backbone"]
+
+
+def test_hrft_params_only_restore(tmp_path):
+    from dinov3_tpu.checkpoint import Checkpointer
+    from dinov3_tpu.train import build_train_setup, put_batch
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, SMOL)
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, 4, seed=0).items()}
+    setup = build_train_setup(cfg, batch)
+    state, _ = setup.step_fn(
+        setup.state, put_batch(batch, setup.batch_shardings),
+        setup.scalars(0), jax.random.key(0),
+    )
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), async_save=False)
+    ckpt.save(1, state)
+    ckpt.close()
+
+    # fresh run restores params only: step resets, params match
+    setup2 = build_train_setup(cfg, batch)
+    ckpt2 = Checkpointer(str(tmp_path / "ckpt"))
+    restored = ckpt2.restore_params_only(setup2.state)
+    ckpt2.close()
+    assert int(restored.step) == 0
+    want = jax.tree.leaves(state.params["student"])
+    got = jax.tree.leaves(restored.params["student"])
+    for w, g in zip(want, got):
+        assert np.allclose(np.asarray(w), np.asarray(g))
