@@ -42,9 +42,14 @@ def collate_crops(
     n_l = len(samples[0]["local_crops"])
 
     def stack(key, n):
-        return np.stack(
-            [samples[b][key][i] for i in range(n) for b in range(B)]
-        ).astype(dtype)
+        items = [samples[b][key][i] for i in range(n) for b in range(B)]
+        if dtype == np.float32:
+            from dinov3_tpu import native
+
+            out = native.stack_crops(items)
+            if out is not None:
+                return out
+        return np.stack(items).astype(dtype)
 
     batch = {"global_crops": stack("global_crops", n_g)}
     if n_l:

@@ -222,8 +222,18 @@ def to_normalized_array(
     mean: Sequence[float] = IMAGENET_MEAN,
     std: Sequence[float] = IMAGENET_STD,
 ) -> np.ndarray:
-    """PIL -> float32 [H, W, 3], scaled to [0,1] then normalized."""
-    arr = np.asarray(img.convert("RGB"), np.float32) / 255.0
+    """PIL -> float32 [H, W, 3], scaled to [0,1] then normalized.
+
+    Uses the fused native kernel (dinov3_tpu/native) when built; numpy
+    otherwise (equivalent within fp32 rounding).
+    """
+    arr_u8 = np.asarray(img.convert("RGB"), np.uint8)
+    from dinov3_tpu import native
+
+    out = native.normalize_image(arr_u8, mean, std)
+    if out is not None:
+        return out
+    arr = arr_u8.astype(np.float32) / 255.0
     mean = np.asarray(mean, np.float32)
     std = np.asarray(std, np.float32)
     return (arr - mean) / std

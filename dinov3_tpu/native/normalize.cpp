@@ -1,0 +1,66 @@
+// Native host-side data-path kernels for the dinov3_tpu input pipeline.
+//
+// (reference analogue: the reference delegated all host image math to
+// torchvision's C++ CPU ops (SURVEY.md intro, requirements.txt:58-59);
+// this framework's pipeline is PIL+numpy, and these kernels replace its
+// hottest numpy inner loops with single-pass C++.)
+//
+// Exposed via ctypes (dinov3_tpu/native/__init__.py); every function is
+// plain C ABI, operates on caller-owned buffers, and is safe to call from
+// multiple Python threads concurrently (no global state).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// uint8 HWC -> float32 HWC, fused (x/255 - mean) / std as x * scale + bias.
+// in:  [n_pixels * 3] uint8
+// out: [n_pixels * 3] float32
+// scale/bias: per-channel fp32, scale[c] = 1/(255*std[c]),
+//             bias[c] = -mean[c]/std[c].
+void normalize_u8_to_f32(const uint8_t* in, float* out, int64_t n_pixels,
+                         const float* scale, const float* bias) {
+  const float s0 = scale[0], s1 = scale[1], s2 = scale[2];
+  const float b0 = bias[0], b1 = bias[1], b2 = bias[2];
+  for (int64_t i = 0; i < n_pixels; ++i) {
+    const uint8_t* p = in + 3 * i;
+    float* q = out + 3 * i;
+    q[0] = (float)p[0] * s0 + b0;
+    q[1] = (float)p[1] * s1 + b1;
+    q[2] = (float)p[2] * s2 + b2;
+  }
+}
+
+// Same, with horizontal flip fused in (per row, left-right reversal).
+void normalize_u8_to_f32_hflip(const uint8_t* in, float* out, int64_t h,
+                               int64_t w, const float* scale,
+                               const float* bias) {
+  const float s0 = scale[0], s1 = scale[1], s2 = scale[2];
+  const float b0 = bias[0], b1 = bias[1], b2 = bias[2];
+  for (int64_t y = 0; y < h; ++y) {
+    const uint8_t* row = in + 3 * y * w;
+    float* orow = out + 3 * y * w;
+    for (int64_t x = 0; x < w; ++x) {
+      const uint8_t* p = row + 3 * (w - 1 - x);
+      float* q = orow + 3 * x;
+      q[0] = (float)p[0] * s0 + b0;
+      q[1] = (float)p[1] * s1 + b1;
+      q[2] = (float)p[2] * s2 + b2;
+    }
+  }
+}
+
+// Crop-major batch stack: for crop index c and image index b, copies
+// srcs[c * batch + b] (each [item_floats] fp32) into
+// dst[(c * batch + b) * item_floats].
+// srcs is an array of n_crops*batch pointers.
+void stack_crops_f32(const float** srcs, float* dst, int64_t n_items,
+                     int64_t item_floats) {
+  for (int64_t i = 0; i < n_items; ++i) {
+    std::memcpy(dst + i * item_floats, srcs[i],
+                (size_t)item_floats * sizeof(float));
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,65 @@
+"""Native C++ data-path kernels vs their numpy fallbacks."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dinov3_tpu import native
+from dinov3_tpu.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+
+requires_native = pytest.mark.skipif(
+    not native.native_available(), reason="no C++ toolchain"
+)
+
+
+def _u8(h=33, w=47, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (h, w, 3), dtype=np.uint8
+    )
+
+
+@requires_native
+def test_normalize_matches_numpy():
+    arr = _u8()
+    got = native.normalize_image(arr, IMAGENET_MEAN, IMAGENET_STD)
+    mean = np.asarray(IMAGENET_MEAN, np.float32)
+    std = np.asarray(IMAGENET_STD, np.float32)
+    want = (arr.astype(np.float32) / 255.0 - mean) / std
+    assert got.shape == want.shape and got.dtype == np.float32
+    assert np.allclose(got, want, atol=1e-5)
+
+
+@requires_native
+def test_normalize_hflip():
+    arr = _u8()
+    got = native.normalize_image(arr, IMAGENET_MEAN, IMAGENET_STD, hflip=True)
+    want = native.normalize_image(
+        arr[:, ::-1], IMAGENET_MEAN, IMAGENET_STD
+    )
+    assert np.allclose(got, want, atol=1e-6)
+
+
+@requires_native
+def test_stack_crops_matches_numpy():
+    rng = np.random.default_rng(0)
+    items = [rng.standard_normal((8, 8, 3)).astype(np.float32)
+             for _ in range(6)]
+    got = native.stack_crops(items)
+    assert np.array_equal(got, np.stack(items))
+    # unsuitable inputs decline gracefully
+    assert native.stack_crops([]) is None
+    assert native.stack_crops(
+        [items[0], items[1][:4]]  # shape mismatch
+    ) is None
+
+
+def test_to_normalized_array_uses_same_semantics_either_path(monkeypatch):
+    from dinov3_tpu.data.transforms import to_normalized_array
+
+    img = Image.fromarray(_u8(16, 16))
+    with_native = to_normalized_array(img)
+    monkeypatch.setenv("DINOV3_TPU_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", False)
+    without = to_normalized_array(img)
+    assert np.allclose(with_native, without, atol=1e-5)
