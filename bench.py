@@ -49,6 +49,9 @@ def main():
         "student.drop_path_rate=0.3",
         "optim.scaling_rule=none",
         "parallel.data=-1",
+        # bf16 parameter storage, as in the reference's own recipe
+        # (vitl_im1k_lin834.yaml compute_precision.param_dtype: bf16)
+        "compute_precision.param_dtype=bf16",
     ])
     B = per_chip * n
     batch_np = make_synthetic_batch(cfg, B, seed=0)
@@ -60,14 +63,16 @@ def main():
     state = setup.state
     scalars = setup.scalars(0)
 
+    # synchronize via a value fetch: block_until_ready can return early
+    # through the tunneled-TPU transport, a fetch cannot
     for _ in range(warmup):
         state, metrics = setup.step_fn(state, dbatch, scalars, rng)
-    jax.block_until_ready(metrics["total_loss"])
+    float(metrics["total_loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = setup.step_fn(state, dbatch, scalars, rng)
-    jax.block_until_ready(metrics["total_loss"])
+    float(metrics["total_loss"])
     dt = (time.perf_counter() - t0) / steps
 
     img_s_chip = B / dt / n

@@ -52,6 +52,15 @@ def _flash_available() -> bool:
         return False
 
 
+# Below this many tokens the dense-softmax XLA path wins on TPU: the whole
+# [N, N] fits in VMEM, XLA fuses RoPE/scale/softmax into the matmuls, and
+# the flash kernel's custom_vjp would block those fusions (measured ~1.45x
+# full-train-step slowdown for ViT-L at N=201 on v5e). Flash takes over
+# where its O(N) memory matters: high-res (518-768px -> 1029-2309 tokens)
+# and ViT-7B.
+FLASH_MIN_SEQ = 1024
+
+
 def dispatch_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     impl: str = "auto", reduce_dtype=jnp.float32,
@@ -59,7 +68,11 @@ def dispatch_attention(
     if impl == "auto":
         impl = (
             "pallas"
-            if jax.default_backend() == "tpu" and _flash_available()
+            if (
+                jax.default_backend() == "tpu"
+                and q.shape[1] >= FLASH_MIN_SEQ
+                and _flash_available()
+            )
             else "xla"
         )
     if impl in ("xla", "reference"):

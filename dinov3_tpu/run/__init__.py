@@ -1,0 +1,4 @@
+from dinov3_tpu.run.init import job_context
+from dinov3_tpu.run.preemption import PreemptionHandler
+
+__all__ = ["job_context", "PreemptionHandler"]

@@ -1,0 +1,33 @@
+"""Job bootstrap context.
+
+(reference: dinov3_jax/run/init.py ``job_context`` contextmanager:18 —
+logging + output dir + timing around a job body. Extended with crash
+logging and a guaranteed-flushed exit record.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+logger = logging.getLogger("dinov3")
+
+
+@contextlib.contextmanager
+def job_context(cfg, name: str = "train"):
+    from dinov3_tpu.configs import setup_job
+    from dinov3_tpu.logging_utils import setup_logging
+
+    setup_job(cfg)
+    setup_logging(cfg.train.output_dir)
+    t0 = time.monotonic()
+    logger.info("job %r starting (output_dir=%s)", name, cfg.train.output_dir)
+    try:
+        yield
+    except Exception:
+        logger.exception("job %r crashed after %.1fs", name,
+                         time.monotonic() - t0)
+        raise
+    finally:
+        logger.info("job %r finished in %.1fs", name, time.monotonic() - t0)

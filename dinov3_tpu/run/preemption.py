@@ -1,0 +1,48 @@
+"""Preemption-aware shutdown for requeueable jobs.
+
+(reference: dinov3_jax/run/submit.py ``CheckpointableSubmitter.checkpoint``
+:140-145 — Slurm/submitit requeue-on-preemption, dead code in the
+reference because its imports didn't exist (SURVEY.md §2.8). The
+TPU-native equivalent: cluster managers (GKE, Borg-style schedulers) send
+SIGTERM with a grace window before reclaiming a slice; this handler turns
+that into a flag the train loop polls, so the loop saves a final
+checkpoint and exits cleanly — the scheduler's retry policy restarts the
+job and ``Checkpointer.restore`` resumes from the saved step.)
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+logger = logging.getLogger("dinov3")
+
+
+class PreemptionHandler:
+    """Installs SIGTERM/SIGINT handlers; poll ``should_stop()`` per step."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._stop = threading.Event()
+        self._previous = {}
+        self._signals = tuple(signals)
+
+    def __enter__(self) -> "PreemptionHandler":
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+
+    def _handle(self, signum, frame) -> None:
+        logger.warning(
+            "received signal %s: will checkpoint and exit at the next "
+            "step boundary", signal.Signals(signum).name,
+        )
+        self._stop.set()
+
+    def should_stop(self) -> bool:
+        return self._stop.is_set()

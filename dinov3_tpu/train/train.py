@@ -132,6 +132,10 @@ def do_train(cfg, args) -> dict:
 
     n_gram_updates = gram_updates_before(cfg, start_iter)
 
+    from dinov3_tpu.run.preemption import PreemptionHandler
+
+    preemption = PreemptionHandler().__enter__()
+
     batch0 = put_batch(first, setup.batch_shardings)
     pending = batch0
     for it, raw in metric_logger.log_every(
@@ -183,11 +187,21 @@ def do_train(cfg, args) -> dict:
                 state.params["teacher"]["backbone"],
             )
             metric_logger.update(**results)
-        if (it + 1) % cfg.checkpointing.period == 0 or it + 1 == total_iters:
+        stopping = preemption.should_stop()
+        if (
+            (it + 1) % cfg.checkpointing.period == 0
+            or it + 1 == total_iters
+            or stopping
+        ):
             ckpt.save(it + 1, state)
+        if stopping:
+            logger.warning("preempted: checkpointed at iteration %d, "
+                           "exiting for requeue", it + 1)
+            break
         if it + 1 >= total_iters:
             break
 
+    preemption.__exit__()
     ckpt.close()
     logger.info("training done at iteration %d, final loss %.4f",
                 int(state.step), last_loss)

@@ -1,0 +1,26 @@
+"""Job context and preemption handler."""
+
+import os
+import signal
+
+from dinov3_tpu.run import PreemptionHandler, job_context
+
+
+def test_preemption_handler_sets_flag():
+    with PreemptionHandler(signals=(signal.SIGUSR1,)) as h:
+        assert not h.should_stop()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert h.should_stop()
+    # handler restored afterwards
+    assert signal.getsignal(signal.SIGUSR1) != h._handle
+
+
+def test_job_context_creates_output_and_logs(tmp_path):
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+
+    cfg = get_default_config()
+    out = tmp_path / "job"
+    apply_dot_overrides(cfg, [f"train.output_dir={out}"])
+    with job_context(cfg, name="unit"):
+        pass
+    assert (out / "config.yaml").exists()
