@@ -99,6 +99,28 @@ class SmoothedValue:
             value=self.value,
         )
 
+    def synchronize_between_processes(self) -> None:
+        """All-reduce (count, total) across hosts.
+
+        (reference: logging/helpers.py:39-46 called ``lax.psum`` outside
+        any shard_map — broken, SURVEY.md §2.8. Here it goes through
+        ``multihost_utils.process_allgather``, the supported cross-process
+        path; the windowed deque stays host-local, matching the torch
+        original which only synced count/total.)
+        """
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        both = multihost_utils.process_allgather(
+            np.asarray([self.count, self.total], np.float64)
+        )
+        self.count = int(both[:, 0].sum())
+        self.total = float(both[:, 1].sum())
+
 
 class MetricLogger:
     """Iteration driver printing smoothed meters + ETA, dumping JSON lines.

@@ -97,6 +97,47 @@ def build_model_from_cfg(cfg: ConfigNode, only_teacher: bool = False):
     return student_model, teacher_model, student_model.embed_dim
 
 
+def build_model_for_eval(cfg: ConfigNode, ckpt_dir: str | None = None):
+    """(model, params) for feature extraction / evals.
+
+    Loads the EMA teacher's backbone from a framework checkpoint directory
+    (the reference's equivalent imported nonexistent ``dinov3.*`` modules,
+    models/__init__.py:81-93 — SURVEY.md §2.2).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    model = build_backbone(cfg, teacher=True)
+    S = cfg.crops.global_crops_size
+    if isinstance(S, (list, tuple)):
+        S = int(S[0])
+    example = jnp.zeros((1, S, S, cfg.student.in_chans), jnp.float32)
+    import flax.linen as nn
+
+    params = nn.meta.unbox(
+        jax.jit(model.init)(jax.random.key(0), example)
+    )["params"]
+    if ckpt_dir:
+        import orbax.checkpoint as ocp
+
+        with ocp.CheckpointManager(ckpt_dir) as manager:
+            step = manager.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, params)
+            restored = manager.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.PyTreeRestore(
+                        {"params": {"teacher": {"backbone": abstract}}},
+                        partial_restore=True,
+                    )
+                ),
+            )
+        params = restored["state"]["params"]["teacher"]["backbone"]
+    return model, params
+
+
 __all__ = [
     "ARCHS", "DinoVisionTransformer", "backbone_kwargs_from_cfg",
     "build_backbone", "build_model_from_cfg", "vit_small", "vit_base",
