@@ -1,0 +1,158 @@
+"""Byte-level BPE tokenizer for caption/retrieval evals.
+
+(reference: dinov3_jax/thirdparty/CLIP/clip/simple_tokenizer.py — a
+vendored CLIP BPE tokenizer whose vocab .gz was not in the tree, so it
+could never actually run (SURVEY.md §2.8). This is a self-contained
+equivalent: the same byte-level BPE scheme, but with an in-repo
+``train_bpe`` so a vocabulary can be built from any caption corpus —
+no external artifact required. ``BPETokenizer`` round-trips arbitrary
+UTF-8 text and pads/truncates to a fixed context length for batched
+text-side evals (CocoCaptions retrieval).)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+_WORD_RE = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?[0-9]+| ?[^\s\w]+|\s+"
+)
+
+
+def _word_to_bytes(word: str) -> tuple:
+    """A word as a tuple of byte-valued tokens, end marker on the last."""
+    bs = word.encode("utf-8")
+    if not bs:
+        return ()
+    toks = [f"b{b}" for b in bs]
+    toks[-1] += "/w"
+    return tuple(toks)
+
+
+def train_bpe(texts: Iterable[str], vocab_size: int = 4096) -> list:
+    """Learn BPE merges from ``texts``.
+
+    Returns a list of (left, right) token-pair merges, most frequent
+    first. Base vocabulary is the 512 byte tokens (with/without the
+    end-of-word marker); ``vocab_size`` bounds base + merges.
+    """
+    word_freq: dict = {}
+    for text in texts:
+        for word in _WORD_RE.findall(text.lower()):
+            if word:
+                key = _word_to_bytes(word)
+                word_freq[key] = word_freq.get(key, 0) + 1
+    words = [list(w) for w in word_freq]
+    freqs = list(word_freq.values())
+    merges: list = []
+    n_base = 512
+    while n_base + len(merges) < vocab_size:
+        pair_counts: dict = {}
+        for word, freq in zip(words, freqs):
+            for a, b in zip(word, word[1:]):
+                pair_counts[(a, b)] = pair_counts.get((a, b), 0) + freq
+        if not pair_counts:
+            break
+        best = max(pair_counts, key=pair_counts.get)
+        if pair_counts[best] < 2:
+            break
+        merges.append(best)
+        merged = best[0] + best[1]
+        for word in words:
+            i = 0
+            while i < len(word) - 1:
+                if word[i] == best[0] and word[i + 1] == best[1]:
+                    word[i : i + 2] = [merged]
+                else:
+                    i += 1
+    return merges
+
+
+class BPETokenizer:
+    """Encode/decode with a fixed merge list.
+
+    Special ids: 0 = pad, 1 = <start>, 2 = <end>; byte tokens and merged
+    tokens follow. ``encode`` greedily applies merges in rank order (the
+    standard BPE inference rule)."""
+
+    PAD, SOT, EOT = 0, 1, 2
+
+    def __init__(self, merges: Sequence[tuple]):
+        self.merges = [tuple(m) for m in merges]
+        self.ranks = {m: i for i, m in enumerate(self.merges)}
+        vocab = ["<pad>", "<start>", "<end>"]
+        vocab += [f"b{b}" for b in range(256)]
+        vocab += [f"b{b}/w" for b in range(256)]
+        vocab += [a + b for a, b in self.merges]
+        self.token_to_id = {t: i for i, t in enumerate(vocab)}
+        self.id_to_token = vocab
+        self._cache: dict = {}
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.id_to_token)
+
+    def _bpe(self, word: str) -> list:
+        if word in self._cache:
+            return self._cache[word]
+        parts = list(_word_to_bytes(word))
+        while len(parts) > 1:
+            pairs = [(self.ranks.get((a, b), 1 << 30), i)
+                     for i, (a, b) in enumerate(zip(parts, parts[1:]))]
+            rank, i = min(pairs)
+            if rank >= 1 << 30:
+                break
+            parts[i : i + 2] = [parts[i] + parts[i + 1]]
+        self._cache[word] = parts
+        return parts
+
+    def encode(self, text: str) -> list:
+        # words keep their leading space byte, so decode is an exact byte
+        # concatenation (no lossy end-of-word respacing)
+        ids = []
+        for word in _WORD_RE.findall(text.lower()):
+            if word:
+                ids += [self.token_to_id[t] for t in self._bpe(word)]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        out = bytearray()
+        for i in ids:
+            if i in (self.PAD, self.SOT, self.EOT):
+                continue
+            # merged tokens are concatenations of byte tokens
+            for piece in self.id_to_token[i].split("b")[1:]:
+                if piece.endswith("/w"):
+                    piece = piece[:-2]
+                out.append(int(piece))
+        return out.decode("utf-8", errors="replace")
+
+    def __call__(self, texts, context_length: int = 77) -> np.ndarray:
+        """Batch-encode to a fixed-shape int32 array: <start> ids <end>,
+        zero-padded / truncated to ``context_length`` (the fixed shape is
+        what makes the text side jittable)."""
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.zeros((len(texts), context_length), np.int32)
+        for row, text in enumerate(texts):
+            ids = [self.SOT] + self.encode(text)[: context_length - 2] + [self.EOT]
+            out[row, : len(ids)] = ids
+        return out
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"merges": self.merges}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            data = json.load(f)
+        return cls([tuple(m) for m in data["merges"]])
+
+    @classmethod
+    def train(cls, texts: Iterable[str], vocab_size: int = 4096) -> "BPETokenizer":
+        return cls(train_bpe(texts, vocab_size))

@@ -1,4 +1,17 @@
 from dinov3_tpu.run.init import job_context
 from dinov3_tpu.run.preemption import PreemptionHandler
+from dinov3_tpu.run.submit import (
+    LocalLauncher,
+    build_sbatch_script,
+    load_callable,
+    submit_job,
+)
 
-__all__ = ["job_context", "PreemptionHandler"]
+__all__ = [
+    "job_context",
+    "PreemptionHandler",
+    "LocalLauncher",
+    "build_sbatch_script",
+    "load_callable",
+    "submit_job",
+]

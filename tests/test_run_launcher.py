@@ -24,3 +24,71 @@ def test_job_context_creates_output_and_logs(tmp_path):
     with job_context(cfg, name="unit"):
         pass
     assert (out / "config.yaml").exists()
+
+
+def test_build_sbatch_script_directives(tmp_path):
+    from dinov3_tpu.run import build_sbatch_script
+
+    target = tmp_path / "trainer.py"
+    target.write_text("def main(argv):\n    pass\n")
+    script = build_sbatch_script(
+        module_path=str(target),
+        script_args=["--config-file", "c.yaml", "optim.epochs=1"],
+        output_dir=str(tmp_path),
+        nodes=4,
+        partition="tpu",
+        account="acct",
+        qos="high",
+        comment="hello world",
+        signal_grace_s=90,
+    )
+    assert "#SBATCH --nodes=4" in script
+    assert "#SBATCH --requeue" in script
+    assert "#SBATCH --signal=TERM@90" in script
+    assert "#SBATCH --partition=tpu" in script
+    assert "JAX_COORDINATOR_ADDRESS" in script
+    assert "JAX_PROCESS_ID" in script
+    assert "initialize_distributed" in script
+    assert "optim.epochs=1" in script
+
+
+def test_submit_job_writes_script_without_sbatch(tmp_path, monkeypatch):
+    from dinov3_tpu.run import build_sbatch_script, submit_job
+
+    monkeypatch.setenv("PATH", "")  # no sbatch on PATH
+    target = tmp_path / "trainer.py"
+    target.write_text("def main(argv):\n    pass\n")
+    script = build_sbatch_script(
+        module_path=str(target), script_args=[], output_dir=str(tmp_path)
+    )
+    job_id = submit_job(script, str(tmp_path))
+    assert job_id is None
+    assert (tmp_path / "job.sbatch").read_text() == script
+
+
+def test_load_callable(tmp_path):
+    from dinov3_tpu.run import load_callable
+
+    target = tmp_path / "mod.py"
+    target.write_text("def entry(argv):\n    return list(argv) + ['ok']\n")
+    fn = load_callable(str(target), "entry")
+    assert fn(["a"]) == ["a", "ok"]
+
+
+def test_local_launcher_two_processes(tmp_path):
+    from dinov3_tpu.run import LocalLauncher
+
+    target = tmp_path / "prog.py"
+    target.write_text(
+        "import jax\n"
+        "def main(argv):\n"
+        "    import pathlib\n"
+        "    n = jax.process_count()\n"
+        "    assert n == 2, n\n"
+        "    pathlib.Path(argv[0] + f'/done{jax.process_index()}').touch()\n"
+    )
+    LocalLauncher(2, port=12457).launch(
+        str(target), [str(tmp_path)], timeout_s=120.0
+    )
+    assert (tmp_path / "done0").exists()
+    assert (tmp_path / "done1").exists()

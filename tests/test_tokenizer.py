@@ -1,0 +1,58 @@
+"""Byte-level BPE tokenizer (reference thirdparty/CLIP equivalent)."""
+
+import numpy as np
+
+from dinov3_tpu.data.tokenizer import BPETokenizer, train_bpe
+
+CORPUS = [
+    "a cat sitting on a mat",
+    "the cat and the dog",
+    "a dog running in the park",
+    "two cats playing with a ball",
+    "the quick brown fox jumps over the lazy dog",
+] * 4
+
+
+def test_roundtrip_without_merges():
+    tok = BPETokenizer([])
+    for text in ["hello world", "caption with 123 numbers!", "émojis ok"]:
+        assert tok.decode(tok.encode(text)) == text.lower()
+
+
+def test_train_reduces_sequence_length():
+    merges = train_bpe(CORPUS, vocab_size=600)
+    assert merges
+    base = BPETokenizer([])
+    trained = BPETokenizer(merges)
+    text = "the cat and the dog"
+    assert len(trained.encode(text)) < len(base.encode(text))
+    assert trained.decode(trained.encode(text)) == text
+
+
+def test_batched_fixed_shape_padding():
+    tok = BPETokenizer.train(CORPUS, vocab_size=600)
+    arr = tok(["a cat", "the quick brown fox jumps over the lazy dog"],
+              context_length=16)
+    assert arr.shape == (2, 16) and arr.dtype == np.int32
+    assert arr[0, 0] == tok.SOT
+    assert tok.EOT in arr[0]
+    # padding is zeros after <end>
+    end0 = list(arr[0]).index(tok.EOT)
+    assert not arr[0, end0 + 1:].any()
+
+
+def test_truncation_keeps_markers():
+    tok = BPETokenizer([])
+    arr = tok("word " * 100, context_length=8)
+    assert arr.shape == (1, 8)
+    assert arr[0, 0] == tok.SOT and arr[0, -1] == tok.EOT
+
+
+def test_save_load(tmp_path):
+    tok = BPETokenizer.train(CORPUS, vocab_size=560)
+    path = str(tmp_path / "bpe.json")
+    tok.save(path)
+    tok2 = BPETokenizer.load(path)
+    text = "cats and dogs"
+    assert tok.encode(text) == tok2.encode(text)
+    assert tok2.vocab_size == tok.vocab_size
