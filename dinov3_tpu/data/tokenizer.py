@@ -49,26 +49,42 @@ def train_bpe(texts: Iterable[str], vocab_size: int = 4096) -> list:
     words = [list(w) for w in word_freq]
     freqs = list(word_freq.values())
     merges: list = []
-    n_base = 512
-    while n_base + len(merges) < vocab_size:
-        pair_counts: dict = {}
-        for word, freq in zip(words, freqs):
-            for a, b in zip(word, word[1:]):
-                pair_counts[(a, b)] = pair_counts.get((a, b), 0) + freq
-        if not pair_counts:
-            break
+    n_base = 512 + 3  # byte tokens (+/w variants) + pad/start/end specials
+
+    # incremental pair counts: only words containing the merged pair are
+    # rescanned per iteration (standard BPE trainer shape)
+    pair_counts: dict = {}
+    pair_words: dict = {}  # pair -> set of word indices containing it
+    def count_word(wi, sign):
+        word, freq = words[wi], freqs[wi]
+        for a, b in zip(word, word[1:]):
+            pair = (a, b)
+            pair_counts[pair] = pair_counts.get(pair, 0) + sign * freq
+            if sign > 0:
+                pair_words.setdefault(pair, set()).add(wi)
+    for wi in range(len(words)):
+        count_word(wi, +1)
+
+    while n_base + len(merges) < vocab_size and pair_counts:
         best = max(pair_counts, key=pair_counts.get)
         if pair_counts[best] < 2:
             break
         merges.append(best)
         merged = best[0] + best[1]
-        for word in words:
+        for wi in sorted(pair_words.get(best, ())):
+            word = words[wi]
+            if len(word) < 2:
+                continue
+            count_word(wi, -1)
             i = 0
             while i < len(word) - 1:
                 if word[i] == best[0] and word[i + 1] == best[1]:
                     word[i : i + 2] = [merged]
                 else:
                     i += 1
+            count_word(wi, +1)
+        pair_counts.pop(best, None)
+        pair_words.pop(best, None)
     return merges
 
 

@@ -56,11 +56,19 @@ def dino_loss(
     """
     S, B, _ = student_logits.shape
     T = teacher_probs.shape[0]
-    log_p = jax.nn.log_softmax(student_logits / student_temp, axis=-1)
+    # CE via <q, logp> = <q, x> - sum_k(q)*lse(x): the prototype-dim
+    # contraction runs on the raw logits (an MXU einsum in their storage
+    # dtype) instead of a materialized fp32 log_softmax buffer.
+    x = student_logits / student_temp
+    lse = jax.scipy.special.logsumexp(
+        x.astype(jnp.float32), axis=-1)                      # [S, B]
+    qsum = jnp.sum(teacher_probs, axis=-1)                   # [T, B]
+    dot = jnp.einsum("sbk,tbk->st", x, teacher_probs,
+                     preferred_element_type=jnp.float32)
+    corr = jnp.einsum("sb,tb->st", lse, qsum)
+    pair_ce = corr - dot                                     # [S, T]
     if ignore_diagonal:
-        pair_ce = -jnp.einsum("sbk,tbk->st", log_p, teacher_probs)
         M = min(S, T)
         pair_ce = pair_ce * (1.0 - jnp.eye(S, T, dtype=pair_ce.dtype))
         return pair_ce.sum() / (B * S * T - B * M)
-    total = -jnp.einsum("sbk,tbk->", log_p, teacher_probs)
-    return total / (B * S * T)
+    return pair_ce.sum() / (B * S * T)

@@ -45,8 +45,15 @@ def ibot_patch_loss_masked(
     loss = -sum_m w_m * <q_m, log p_m> / n_images  == mean over images of the
     mean CE over that image's masked tokens (PyTorch DINOv3 semantics).
     """
-    log_p = jax.nn.log_softmax(student_logits / student_temp, axis=-1)
-    per_token = jnp.sum(teacher_probs * log_p, axis=-1)  # [M]
+    # CE without materializing log-probs: <q, logp> = <q, x> - sum(q)*lse(x)
+    # — the [M, K] fp32 log_softmax buffer (65k-262k prototypes) never
+    # exists; x is read in its storage dtype with fp32 accumulation.
+    x = student_logits / student_temp
+    lse = jax.scipy.special.logsumexp(x.astype(jnp.float32), axis=-1)  # [M]
+    # bf16 x * fp32 q promotes elementwise inside the fused reduction —
+    # no fp32 copy of x is materialized
+    dot = jnp.sum(teacher_probs * x, axis=-1)                          # [M]
+    per_token = dot - jnp.sum(teacher_probs, axis=-1) * lse
     return -jnp.sum(per_token * masks_weight) / max(n_images, 1)
 
 

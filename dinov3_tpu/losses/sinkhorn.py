@@ -34,7 +34,6 @@ def sinkhorn_knopp(
     ``sum(row_weights)`` (the reference's ``n_masked_patches`` psum).
     Returns [B, K] assignment probabilities (each valid row sums to 1).
     """
-    logits = logits.astype(reduce_dtype)
     B, K = logits.shape
     NEG = jnp.asarray(-1e30, reduce_dtype)  # "-inf" that stays NaN-free
     # Work entirely in the log domain: the iterations are algebraically
@@ -42,25 +41,46 @@ def sinkhorn_knopp(
     # logsumexp subtraction) but cannot over/underflow — the reference's
     # raw ``exp(logits/T)`` overflowed for |logits|/T > ~88 and its Q
     # underflowed to all-zero columns at low temperatures.
-    log_q = logits / temperature  # [B, K], rows = samples
+    #
+    # Offset form: after one materialized global normalization the iterate
+    # is represented as ``xs - r_i - c_j`` for per-row / per-column offset
+    # vectors, so each half-iteration is a read-only reduction over ``xs``
+    # instead of a read-modify-write of the [B, K] fp32 buffer — ~40% less
+    # HBM traffic for the 65k–262k-prototype heads this normalizes.
+    x = logits / jnp.asarray(temperature, logits.dtype)  # [B, K]
     if row_weights is not None:
         valid = row_weights.astype(reduce_dtype) > 0
-        log_q = jnp.where(valid[:, None], log_q, NEG)
         B_eff = jnp.maximum(jnp.sum(valid.astype(reduce_dtype)), 1.0)
         log_B = jnp.log(B_eff)
+        row_pad = jnp.where(valid, 0.0, NEG)  # [B], -inf on padding rows
     else:
         valid = None
         log_B = jnp.log(jnp.asarray(B, reduce_dtype))
-    log_K = jnp.log(jnp.asarray(K, reduce_dtype))
+        row_pad = None
 
-    log_q = log_q - jax.nn.logsumexp(log_q)  # sum_Q normalization
+    xf = x.astype(reduce_dtype)
+    if row_pad is not None:
+        xf = xf + row_pad[:, None]
+    # One materialized global normalization (brings values to small
+    # magnitude, which keeps the offset subtractions below full-precision
+    # ulp — iterating offsets against raw logits would re-incur
+    # |logits/T|-scale rounding on every pass); everything after is
+    # read-only against xs.
+    xs = xf - jax.nn.logsumexp(xf)
+    r = jnp.zeros((B, 1), reduce_dtype)   # row offsets
+    c = jnp.zeros((1, K), reduce_dtype)   # column offsets
+    log_K = jnp.log(jnp.asarray(K, reduce_dtype))
     for _ in range(n_iterations):
         # prototype marginal -> uniform 1/K (reduce over samples)
-        log_q = log_q - jax.nn.logsumexp(log_q, axis=0, keepdims=True) - log_K
+        c = c + jax.nn.logsumexp(xs - r - c, axis=0, keepdims=True) + log_K
         # sample marginal -> uniform 1/B (reduce over prototypes)
-        log_q = log_q - jax.nn.logsumexp(log_q, axis=1, keepdims=True) - log_B
+        dr = jax.nn.logsumexp(xs - r - c, axis=1, keepdims=True) + log_B
         if valid is not None:
-            log_q = jnp.where(valid[:, None], log_q, NEG)
+            # padding rows keep their offset, staying at ~NEG so they
+            # contribute nothing to later column reductions
+            dr = jnp.where(valid[:, None], dr, 0.0)
+        r = r + dr
+    log_q = xs - r - c
     q = jnp.exp(log_q + log_B)  # each valid row sums to 1
     if valid is not None:
         q = jnp.where(valid[:, None], q, 0.0)

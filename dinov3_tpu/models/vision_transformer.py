@@ -31,7 +31,11 @@ from dinov3_tpu.ops.block import SelfAttentionBlock
 from dinov3_tpu.ops.common import canonical_dtype, part
 from dinov3_tpu.ops.norms import make_norm_layer
 from dinov3_tpu.ops.patch_embed import PatchEmbed
-from dinov3_tpu.ops.rope import rope_periods, rope_sincos
+from dinov3_tpu.ops.rope import (
+    rope_periods,
+    rope_sincos,
+    rope_with_identity_prefix,
+)
 
 
 class _ScanBlock(nn.Module):
@@ -150,7 +154,7 @@ class DinoVisionTransformer(nn.Module):
         )
         if not deterministic and augmenting:
             rng = self.make_rng("rope")
-        return rope_sincos(
+        sin, cos = rope_sincos(
             h, w, periods,
             normalize=self.pos_embed_rope_normalize_coords,
             rng=rng,
@@ -159,6 +163,9 @@ class DinoVisionTransformer(nn.Module):
             rescale=self.pos_embed_rope_rescale_coords,
             dtype=canonical_dtype(self.pos_embed_rope_dtype),
         )
+        # full-length table (identity rows for CLS/storage tokens): the
+        # per-block apply becomes one fused fma, no token slice/concat
+        return rope_with_identity_prefix(sin, cos, 1 + self.n_storage_tokens)
 
     # ---------------- layer stack ----------------
 

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from dinov3_tpu.ops.common import constrain, part, trunc_normal_init
-from dinov3_tpu.ops.rope import rope_apply_with_prefix
+from dinov3_tpu.ops.rope import rope_apply_full, rope_apply_with_prefix
 
 
 def xla_attention(
@@ -133,7 +133,13 @@ class SelfAttention(nn.Module):
         q, k, v = jnp.moveaxis(qkv, 2, 0)  # each [B, N, h, d]
         if rope is not None:
             sin, cos = rope
-            q, k = rope_apply_with_prefix(q, k, sin, cos, dtype=self.reduce_dtype)
+            if sin.shape[-2] == N:
+                # full-length table (identity prefix rows): fused fma path
+                q, k = rope_apply_full(q, k, sin, cos)
+            else:
+                q, k = rope_apply_with_prefix(
+                    q, k, sin, cos, dtype=self.reduce_dtype
+                )
 
         out = None
         if self.seq_parallel:

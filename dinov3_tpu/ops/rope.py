@@ -129,6 +129,51 @@ def rope_apply(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray) -> jnp.ndarra
     return x * cos + rope_rotate_half(x) * sin
 
 
+def rope_with_identity_prefix(
+    sin: jnp.ndarray, cos: jnp.ndarray, n_prefix: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Prepend identity rotations (sin=0, cos=1) for prefix tokens.
+
+    Lets the per-block apply be one full-sequence fma with no token-axis
+    slice/concat: CLS + storage tokens rotate by the identity instead of
+    being carved out and re-concatenated in every block (the fusion-breaking
+    pattern the reference had, dinov3_jax/layers/attention.py:77-87)."""
+    if n_prefix == 0:
+        return sin, cos
+    pad_sin = jnp.zeros((n_prefix, sin.shape[-1]), sin.dtype)
+    pad_cos = jnp.ones((n_prefix, cos.shape[-1]), cos.dtype)
+    return (jnp.concatenate([pad_sin, sin], axis=0),
+            jnp.concatenate([pad_cos, cos], axis=0))
+
+
+def rope_apply_full(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    sin: jnp.ndarray,
+    cos: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Rotate q/k ([B, N, heads, head_dim]) by a full-length table
+    ([N, head_dim], identity rows for prefix tokens).
+
+    Half-pair formulation (out1 = x1*c - x2*s; out2 = x2*c + x1*s) — the
+    same math as ``rope_apply``'s rotate-half but with no negation pass,
+    computed in the table's dtype (fp32 tables upcast q/k transiently;
+    bf16 tables keep the whole chain in bf16)."""
+    compute = jnp.promote_types(q.dtype, sin.dtype)
+    half = sin.shape[-1] // 2
+    # tables duplicate their halves ([ang, ang]); one half suffices
+    s = sin[None, :, None, :half].astype(compute)
+    c = cos[None, :, None, :half].astype(compute)
+
+    def rot(t):
+        x = t.astype(compute)
+        x1, x2 = x[..., :half], x[..., half:]
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        return out.astype(t.dtype)
+
+    return rot(q), rot(k)
+
+
 def rope_apply_with_prefix(
     q: jnp.ndarray,
     k: jnp.ndarray,

@@ -48,6 +48,20 @@ def load_callable(module_path: str, callable_name: str = "main") -> Callable:
     return fn
 
 
+def _make_shim(module_path: str, callable_name: str, prologue: str = "") -> str:
+    """The inline child program every launch path runs: optional env
+    prologue -> multi-host init -> load and invoke the target callable."""
+    return (
+        "import sys; "
+        + prologue
+        + "from dinov3_tpu.run.submit import load_callable; "
+        "from dinov3_tpu.parallel import initialize_distributed; "
+        "initialize_distributed(); "
+        f"load_callable({os.path.realpath(module_path)!r}, "
+        f"{callable_name!r})(sys.argv[1:])"
+    )
+
+
 def build_sbatch_script(
     *,
     module_path: str,
@@ -105,20 +119,19 @@ def build_sbatch_script(
     # the shim maps per-task Slurm env -> JAX multi-host env itself, so the
     # srun line needs no nested bash -c quoting (script args stay intact
     # whatever characters they contain)
-    shim = (
-        "import os, sys; "
-        "os.environ.setdefault('JAX_PROCESS_ID', os.environ['SLURM_PROCID']); "
-        "from dinov3_tpu.run.submit import load_callable; "
-        "from dinov3_tpu.parallel import initialize_distributed; "
-        "initialize_distributed(); "
-        f"load_callable({os.path.realpath(module_path)!r}, "
-        f"{callable_name!r})(sys.argv[1:])"
+    shim = _make_shim(
+        module_path, callable_name,
+        prologue=("import os; os.environ.setdefault("
+                  "'JAX_PROCESS_ID', os.environ['SLURM_PROCID']); "),
     )
     args = " ".join(shlex.quote(a) for a in script_args)
     lines += [
-        "# first task on the first node is the JAX coordinator",
+        "# first task on the first node is the JAX coordinator; port is",
+        "# derived from the job id so co-scheduled / requeued jobs on the",
+        "# same head node cannot join each other's rendezvous",
         'head_node=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)',
-        "export JAX_COORDINATOR_ADDRESS=${head_node}:12321",
+        "coord_port=$((12000 + SLURM_JOB_ID % 2000))",
+        "export JAX_COORDINATOR_ADDRESS=${head_node}:${coord_port}",
         "export JAX_NUM_PROCESSES=$SLURM_NTASKS",
         f"srun --kill-on-bad-exit=1 {shlex.quote(sys.executable)} "
         f"-c {shlex.quote(shim)} {args}",
@@ -141,9 +154,15 @@ def submit_job(script: str, output_dir: str) -> Optional[str]:
             ["sbatch", "--parsable", str(script_path)],
             capture_output=True, text=True, check=True,
         )
-    except (FileNotFoundError, subprocess.CalledProcessError) as e:
-        logger.warning("sbatch unavailable (%s); script left at %s", e, script_path)
+    except FileNotFoundError:
+        logger.warning("sbatch not on PATH; script left at %s for manual "
+                       "submission", script_path)
         return None
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"sbatch rejected the job (exit {e.returncode}): "
+            f"{(e.stderr or e.stdout or '').strip()}"
+        ) from e
     job_id = proc.stdout.strip().split(";")[0]
     logger.info("submitted job %s; logs under %s", job_id, output_dir)
     return job_id
@@ -165,14 +184,7 @@ class LocalLauncher:
 
     def launch(self, module_path: str, script_args: Sequence[str] = (),
                callable_name: str = "main", timeout_s: float = 600.0) -> None:
-        shim = (
-            "import sys; "
-            "from dinov3_tpu.run.submit import load_callable; "
-            "from dinov3_tpu.parallel import initialize_distributed; "
-            "initialize_distributed(); "
-            f"load_callable({os.path.realpath(module_path)!r}, "
-            f"{callable_name!r})(sys.argv[1:])"
-        )
+        shim = _make_shim(module_path, callable_name)
         # package root on PYTHONPATH so children import this framework from
         # any cwd; the parent's PYTHONPATH is dropped because accelerator
         # tunnels inject sitecustomize modules there that register device
@@ -203,17 +215,22 @@ class LocalLauncher:
             ))
         import time as _time
 
+        # poll rather than wait sequentially: one child dying (import
+        # error, assert) leaves the rest blocked in collectives on a dead
+        # coordinator — fail fast and kill the group
         deadline = _time.monotonic() + timeout_s
         failed = []
-        for pid, proc in enumerate(procs):
-            try:
-                ret = proc.wait(timeout=max(0.0, deadline - _time.monotonic()))
-            except subprocess.TimeoutExpired:
-                ret = -1
-            if ret != 0:
-                failed.append((pid, ret))
+        while _time.monotonic() < deadline:
+            exits = {pid: proc.poll() for pid, proc in enumerate(procs)}
+            failed = [(pid, r) for pid, r in exits.items()
+                      if r is not None and r != 0]
+            if failed or all(r is not None for r in exits.values()):
+                break
+            _time.sleep(0.2)
+        else:
+            failed = [(pid, -1) for pid, proc in enumerate(procs)
+                      if proc.poll() is None]
         if failed:
-            # a dead peer can leave the rest blocked in collectives
             for proc in procs:
                 if proc.poll() is None:
                     proc.kill()

@@ -1,0 +1,144 @@
+"""Warm-start initialization from pretrained checkpoints.
+
+(reference: the ``student.pretrained_weights`` and
+``student.resume_from_teacher_chkpt`` keys of
+dinov3_jax/configs/ssl_default_config.yaml — declared but wired to
+nothing in the reference trainer. Here they work:
+
+- ``student.pretrained_weights`` — a Checkpointer directory of a previous
+  run; its **student** branch initializes this run's student, and the
+  teacher starts as a copy of the student (the DINO convention for a
+  momentum teacher at step 0).
+- ``student.resume_from_teacher_chkpt`` — a Checkpointer directory; its
+  **teacher** branch (the EMA weights DINOv3 evaluates) initializes this
+  run's student backbone — the warm-start used when fine-tuning or
+  re-anchoring from a finished run's teacher.
+
+Both are partial restores: head shapes may differ across recipes (e.g.
+prototype counts), in which case only the matching subtrees load.)
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+from dinov3_tpu.configs import ConfigNode
+
+logger = logging.getLogger("dinov3")
+
+
+def _matching_request(saved_meta, target, target_shardings):
+    """The subtree of ``target`` whose leaves exist in the checkpoint with
+    identical shapes, as ShapeDtypeStructs; None where nothing matches."""
+    if isinstance(target, dict):
+        if not isinstance(saved_meta, dict):
+            return None
+        out = {}
+        for k, v in target.items():
+            if k in saved_meta:
+                sub = _matching_request(saved_meta[k], v, target_shardings[k])
+                if sub is not None:
+                    out[k] = sub
+        return out or None
+    shape = getattr(saved_meta, "shape", None)
+    if shape is not None and tuple(shape) == tuple(target.shape):
+        return jax.ShapeDtypeStruct(
+            target.shape, target.dtype, sharding=target_shardings
+        )
+    return None
+
+
+def _merge_restored(dst, src):
+    if isinstance(dst, dict):
+        return {k: (_merge_restored(v, src[k]) if k in src else v)
+                for k, v in dst.items()}
+    return src
+
+
+def _restore_branch(path: str, branch: str, target, target_shardings):
+    """Restore ``params[branch]`` from the checkpoint at ``path``, shaped
+    and sharded like ``target``; leaves missing from the checkpoint — or
+    saved with different shapes (head prototype counts differ across
+    recipes) — keep their ``target`` values."""
+    import orbax.checkpoint as ocp
+
+    with ocp.CheckpointManager(
+        path, item_handlers={"state": ocp.PyTreeCheckpointHandler()}
+    ) as manager:
+        step = manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {path}")
+        meta = manager.item_metadata(step)["state"].tree
+        saved_branch = (meta.get("params") or {}).get(branch)
+        if saved_branch is None:
+            raise KeyError(f"checkpoint at {path} has no params[{branch!r}]")
+        request = _matching_request(saved_branch, target, target_shardings)
+        if request is None:
+            raise ValueError(
+                f"no leaf of params[{branch!r}] in {path} matches the "
+                "target shapes"
+            )
+        restored = manager.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.PyTreeRestore(
+                    {"params": {branch: request}}, partial_restore=True
+                )
+            ),
+        )
+    loaded = _merge_restored(target, restored["state"]["params"][branch])
+    n_req = len(jax.tree.leaves(request))
+    n_all = len(jax.tree.leaves(target))
+    logger.info("loaded %r branch from %s step %d (%d/%d leaves matched)",
+                branch, path, step, n_req, n_all)
+    return loaded, step
+
+
+def _mirror_into(dst, src):
+    """Copy ``src`` leaves into ``dst`` wherever path+shape match (the
+    teacher mirrors the warm-started student only where architectures
+    agree)."""
+    flat_src = dict(jax.tree_util.tree_flatten_with_path(src)[0])
+    flat_dst, treedef = jax.tree_util.tree_flatten_with_path(dst)
+    out = []
+    for path, leaf in flat_dst:
+        cand = flat_src.get(path)
+        out.append(cand if cand is not None and cand.shape == leaf.shape
+                   else leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def load_pretrained_weights(cfg: ConfigNode, state, state_shardings):
+    """Apply the student warm-start keys to a freshly initialized state."""
+    from_teacher = cfg.student.get("resume_from_teacher_chkpt") or ""
+    from_student = cfg.student.get("pretrained_weights") or ""
+    if not from_teacher and not from_student:
+        return state
+
+    new_params = dict(state.params)
+    if from_teacher:
+        # checkpoint's teacher branch -> this run's student
+        loaded, _ = _restore_branch(
+            from_teacher, "teacher",
+            state.params["student"], state_shardings.params["student"],
+        )
+        new_params["student"] = loaded
+    else:
+        loaded, _ = _restore_branch(
+            from_student, "student",
+            state.params["student"], state_shardings.params["student"],
+        )
+        new_params["student"] = loaded
+    # teacher starts as a copy of the warm-started student where shapes
+    # match (momentum teacher at step 0); distillation teachers with a
+    # different arch keep their own init/restore
+    new_params["teacher"] = _mirror_into(
+        state.params["teacher"], new_params["student"]
+    )
+    if "gram" in new_params:
+        new_params["gram"] = _mirror_into(
+            new_params["gram"], {"backbone": new_params["student"]["backbone"]}
+        )
+    return state._replace(params=new_params)

@@ -109,6 +109,11 @@ def do_train(cfg, args) -> dict:
         state = hrft_ckpt.restore_params_only(state)
         hrft_ckpt.close()
         logger.info("hrft: params loaded from %s", cfg.hrft.checkpoint_path)
+    elif (cfg.student.get("pretrained_weights")
+          or cfg.student.get("resume_from_teacher_chkpt")):
+        from dinov3_tpu.train.pretrained import load_pretrained_weights
+
+        state = load_pretrained_weights(cfg, state, setup.state_shardings)
 
     prof = None
     if args.profile_steps:

@@ -92,3 +92,24 @@ def test_local_launcher_two_processes(tmp_path):
     )
     assert (tmp_path / "done0").exists()
     assert (tmp_path / "done1").exists()
+
+
+def test_local_launcher_fails_fast_on_child_error(tmp_path):
+    import time
+
+    from dinov3_tpu.run import LocalLauncher
+
+    target = tmp_path / "bad.py"
+    target.write_text("def main(argv):\n    raise SystemExit(3)\n")
+    t0 = time.monotonic()
+    try:
+        LocalLauncher(2, port=12473).launch(
+            str(target), [], timeout_s=300.0
+        )
+        raised = False
+    except RuntimeError as e:
+        raised = True
+        assert "3" in str(e)
+    assert raised
+    # far less than the 300s deadline: the group was killed on first failure
+    assert time.monotonic() - t0 < 120
