@@ -116,6 +116,12 @@ def load_pretrained_weights(cfg: ConfigNode, state, state_shardings):
     from_student = cfg.student.get("pretrained_weights") or ""
     if not from_teacher and not from_student:
         return state
+    if from_teacher and from_student:
+        raise ValueError(
+            "student.pretrained_weights and "
+            "student.resume_from_teacher_chkpt are mutually exclusive "
+            f"(got {from_student!r} and {from_teacher!r})"
+        )
 
     new_params = dict(state.params)
     if from_teacher:

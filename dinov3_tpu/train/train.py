@@ -50,6 +50,17 @@ def get_args_parser():
                         "trace into <output-dir>/trace")
     p.add_argument("--max-iterations", type=int, default=-1,
                    help="hard cap on iterations (smoke runs)")
+    p.add_argument("--record-losses", default="",
+                   help="write per-iteration losses to this JSON-lines file "
+                        "(numerical-parity recording)")
+    p.add_argument("--ref-losses", default="",
+                   help="compare per-iteration losses against a recorded "
+                        "file; divergences are logged and summarized")
+    p.add_argument("--dump-weights", default="",
+                   help="after training, dump final params to this .npz")
+    p.add_argument("--benchmark", type=int, default=0, metavar="N",
+                   help="measure steady-state step time over the last N "
+                        "iterations and log img/s")
     p.add_argument("opts", nargs="*", default=[],
                    help="key.path=value config overrides")
     return p
@@ -120,6 +131,24 @@ def do_train(cfg, args) -> dict:
         a, b = (int(x) for x in args.profile_steps.split(","))
         prof = (a, b)
 
+    from dinov3_tpu.utils import (
+        LossComparator,
+        LossRecorder,
+        count_parameters,
+        format_parameter_counts,
+    )
+
+    logger.info("parameters:\n%s", format_parameter_counts(
+        count_parameters(state.params)))
+    # metrics are cross-device means, identical on every host: record and
+    # compare only on the main process (the file may only exist there)
+    recorder = (LossRecorder(args.record_losses)
+                if args.record_losses and is_main_process() else None)
+    comparator = (LossComparator(args.ref_losses)
+                  if args.ref_losses and is_main_process() else None)
+    bench_n = max(0, int(args.benchmark))
+    step_times: list = []
+
     metric_logger = MetricLogger(
         output_file=f"{cfg.train.output_dir}/training_metrics.json"
         if is_main_process() else None,
@@ -157,9 +186,18 @@ def do_train(cfg, args) -> dict:
             setup.batch_shardings,
         )
 
-        # host-side schedule values for the log line
+        # host-side schedule values for the log line; one device->host
+        # fetch of the metrics, shared by every consumer below
         sched = setup.schedules.at(it)
-        last_loss = float(metrics["total_loss"])
+        host_metrics = {k: float(v) for k, v in metrics.items()}
+        last_loss = host_metrics["total_loss"]
+        if recorder is not None:
+            recorder.record(it, host_metrics)
+        if comparator is not None:
+            comparator.check(it, host_metrics)
+        if bench_n and it >= total_iters - bench_n:
+            # the metrics fetch above synced, so the step has completed
+            step_times.append(time.perf_counter())
         if not math.isfinite(last_loss):
             nan_streak += 1
             logger.warning("non-finite loss at iteration %d", it)
@@ -173,7 +211,7 @@ def do_train(cfg, args) -> dict:
         metric_logger.update(
             lr=sched["lr"], wd=sched["weight_decay"], mom=sched["momentum"],
             teacher_temp=sched["teacher_temp"],
-            **{k: float(v) for k, v in metrics.items()},
+            **host_metrics,
         )
         if prof and it == prof[1]:
             jax.tree.leaves(state.params)[0].block_until_ready()
@@ -208,9 +246,26 @@ def do_train(cfg, args) -> dict:
 
     preemption.__exit__()
     ckpt.close()
+    result = {"final_loss": last_loss, "iterations": int(state.step)}
+    if recorder is not None:
+        recorder.close()
+        logger.info("recorded losses to %s", args.record_losses)
+    if comparator is not None:
+        logger.info("loss comparison: %s", comparator.summary())
+        result["loss_divergences"] = comparator.n_diverged
+    if len(step_times) >= 2:
+        dt = (step_times[-1] - step_times[0]) / (len(step_times) - 1)
+        img_s = B / dt
+        logger.info("benchmark: %.1f ms/step, %.1f img/s (%d devices)",
+                    dt * 1e3, img_s, n_devices)
+        result["img_per_sec"] = img_s
+    if args.dump_weights and is_main_process():
+        from dinov3_tpu.utils import dump_weights
+
+        dump_weights(args.dump_weights, state.params)
     logger.info("training done at iteration %d, final loss %.4f",
-                int(state.step), last_loss)
-    return {"final_loss": last_loss, "iterations": int(state.step)}
+                int(state.step), result["final_loss"])
+    return result
 
 
 def main(argv=None):

@@ -1,0 +1,120 @@
+"""Small shared utilities: parameter counting, loss recording/comparison,
+weight dumps.
+
+(reference: dinov3_jax/utils/utils.py ``count_parameters`` — which
+contained a live ``IPython.embed()`` (SURVEY.md §2.9) — and the trainer's
+declared-but-unwired verification flags ``--record-ref-losses`` /
+``--ref-losses-path`` / ``--dump-fsdp-weights``
+(dinov3_jax/train/train.py:63-69, never referenced again). Here they all
+function; the loss recorder/comparator is the numerical-parity workflow
+the reference intended: record per-iteration losses from a trusted run,
+then compare a refactored run against them within a tolerance.)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Mapping
+
+import jax
+import numpy as np
+
+logger = logging.getLogger("dinov3")
+
+
+def count_parameters(params, by_top_level: bool = True) -> dict:
+    """{submodule: parameter count} plus a ``total`` entry."""
+    out: dict = {}
+    if by_top_level and isinstance(params, Mapping):
+        for key, sub in params.items():
+            out[key] = sum(int(np.prod(x.shape))
+                           for x in jax.tree.leaves(sub))
+    out["total"] = sum(v for k, v in out.items()) if out else sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(params)
+    )
+    return out
+
+
+def format_parameter_counts(counts: dict) -> str:
+    width = max(len(k) for k in counts)
+    lines = [f"{k:<{width}}  {v / 1e6:10.2f} M" for k, v in counts.items()]
+    return "\n".join(lines)
+
+
+class LossRecorder:
+    """Append per-iteration scalar dicts; written as JSON lines."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "w")
+
+    def record(self, iteration: int, metrics: Mapping[str, float]) -> None:
+        row = {"iteration": int(iteration)}
+        row.update({k: float(v) for k, v in metrics.items()})
+        self._f.write(json.dumps(row) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class LossComparator:
+    """Compare a run's losses against a recorded file, iteration by
+    iteration. ``check`` logs each divergence and returns whether the
+    iteration matched; ``summary`` reports the worst deviation."""
+
+    def __init__(self, path: str, rtol: float = 1e-3, atol: float = 1e-4):
+        self.rows = {}
+        with open(path) as f:
+            for line in f:
+                row = json.loads(line)
+                self.rows[int(row.pop("iteration"))] = row
+        self.rtol, self.atol = rtol, atol
+        self.worst: tuple = (0.0, None, -1)  # (abs err, key, iteration)
+        self.n_checked = 0
+        self.n_diverged = 0
+
+    def check(self, iteration: int, metrics: Mapping[str, float]) -> bool:
+        ref = self.rows.get(int(iteration))
+        if ref is None:
+            return True
+        self.n_checked += 1
+        ok = True
+        for key, want in ref.items():
+            got = metrics.get(key)
+            if got is None:
+                continue
+            got = float(got)
+            err = abs(got - want)
+            if err > self.atol + self.rtol * abs(want):
+                ok = False
+                logger.warning(
+                    "loss divergence at iter %d: %s = %.6g, recorded %.6g",
+                    iteration, key, got, want,
+                )
+            if err > self.worst[0]:
+                self.worst = (err, key, iteration)
+        self.n_diverged += not ok
+        return ok
+
+    def summary(self) -> str:
+        err, key, it = self.worst
+        head = (f"compared {self.n_checked} iterations, "
+                f"{self.n_diverged} diverged")
+        if key is None:
+            return head + "; exact match"
+        return head + f"; worst |err| {err:.3g} on {key!r} at iter {it}"
+
+
+def dump_weights(path: str, params) -> None:
+    """Flat ``.npz`` dump of a parameter tree ('/'-joined keys) for offline
+    inspection or cross-framework diffing."""
+    flat = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath
+        )
+        flat[name] = np.asarray(leaf)
+    np.savez(path, **flat)
+    logger.info("dumped %d arrays to %s", len(flat), path)

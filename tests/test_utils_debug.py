@@ -1,0 +1,89 @@
+"""Parameter counting, loss record/compare, weight dumps, in-train bench
+(the reference declared these debug flags but never wired them)."""
+
+import json
+
+import numpy as np
+
+from dinov3_tpu.utils import (
+    LossComparator,
+    LossRecorder,
+    count_parameters,
+    dump_weights,
+    format_parameter_counts,
+)
+
+SMOL = [
+    "student.arch=vit_test", "student.patch_size=4",
+    "student.drop_path_rate=0.0",
+    "crops.global_crops_size=16", "crops.local_crops_size=8",
+    "crops.local_crops_number=2",
+    "dino.head_n_prototypes=64", "dino.head_hidden_dim=32",
+    "dino.head_bottleneck_dim=16",
+    "ibot.head_n_prototypes=64", "ibot.head_hidden_dim=32",
+    "ibot.head_bottleneck_dim=16",
+    "train.OFFICIAL_EPOCH_LENGTH=3", "optim.epochs=1",
+    "optim.warmup_epochs=0", "optim.scaling_rule=none",
+    "data.backend=synthetic",
+]
+
+
+def test_count_parameters_by_submodule():
+    params = {"student": {"w": np.zeros((3, 4)), "b": np.zeros((4,))},
+              "teacher": {"w": np.zeros((3, 4))}}
+    counts = count_parameters(params)
+    assert counts == {"student": 16, "teacher": 12, "total": 28}
+    table = format_parameter_counts(counts)
+    assert "student" in table and "total" in table
+
+
+def test_loss_record_then_compare_roundtrip(tmp_path):
+    path = str(tmp_path / "losses.jsonl")
+    rec = LossRecorder(path)
+    rec.record(0, {"total_loss": 1.5, "dino": 0.5})
+    rec.record(1, {"total_loss": 1.25, "dino": 0.4})
+    rec.close()
+    rows = [json.loads(x) for x in open(path)]
+    assert rows[1]["total_loss"] == 1.25
+
+    cmp = LossComparator(path)
+    assert cmp.check(0, {"total_loss": 1.5, "dino": 0.5})
+    assert cmp.check(1, {"total_loss": 1.25, "dino": 0.4})
+    assert cmp.n_diverged == 0
+    # a diverging value is caught
+    cmp2 = LossComparator(path)
+    assert not cmp2.check(0, {"total_loss": 2.0, "dino": 0.5})
+    assert cmp2.n_diverged == 1 and "total_loss" in cmp2.summary()
+
+
+def test_dump_weights_flat_npz(tmp_path):
+    path = str(tmp_path / "w.npz")
+    dump_weights(path, {"a": {"b": np.ones((2, 2))}, "c": np.zeros((3,))})
+    loaded = np.load(path)
+    assert set(loaded.files) == {"a/b", "c"}
+    np.testing.assert_array_equal(loaded["a/b"], np.ones((2, 2)))
+
+
+def test_trainer_record_compare_benchmark_flags(tmp_path):
+    from dinov3_tpu.train.train import main
+
+    rec_path = str(tmp_path / "ref.jsonl")
+    out1 = main([
+        "--output-dir", str(tmp_path / "r1"), "--no-resume",
+        "--record-losses", rec_path,
+        "--dump-weights", str(tmp_path / "final.npz"),
+        "--benchmark", "2",
+        *SMOL,
+    ])
+    assert out1["iterations"] == 3
+    assert "img_per_sec" in out1
+    assert (tmp_path / "final.npz").exists()
+    assert len(open(rec_path).readlines()) == 3
+
+    # identical seed/config -> zero divergences against the recording
+    out2 = main([
+        "--output-dir", str(tmp_path / "r2"), "--no-resume",
+        "--ref-losses", rec_path,
+        *SMOL,
+    ])
+    assert out2["loss_divergences"] == 0
