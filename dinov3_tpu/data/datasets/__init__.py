@@ -4,9 +4,11 @@ from dinov3_tpu.data.datasets.image_net import ImageNet
 from dinov3_tpu.data.datasets.image_net_22k import ImageNet22k
 from dinov3_tpu.data.datasets.ade20k import ADE20K
 from dinov3_tpu.data.datasets.coco_captions import CocoCaptions
+from dinov3_tpu.data.datasets.image_folder import ImageFolder
 from dinov3_tpu.data.datasets.synthetic_images import SyntheticImages
 
 __all__ = [
     "ImageDataDecoder", "TargetDecoder", "ExtendedVisionDataset",
     "ImageNet", "ImageNet22k", "ADE20K", "CocoCaptions", "SyntheticImages",
+    "ImageFolder",
 ]

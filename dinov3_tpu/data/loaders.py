@@ -68,6 +68,7 @@ def make_dataset(
         "ADE20K": D.ADE20K,
         "CocoCaptions": D.CocoCaptions,
         "Synthetic": D.SyntheticImages,
+        "Folder": D.ImageFolder,
     }
     if name not in registry:
         raise ValueError(f"unknown dataset {name!r} (have {sorted(registry)})")

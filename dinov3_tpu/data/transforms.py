@@ -156,6 +156,10 @@ class ColorJitter:
     op order."""
 
     def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0):
+        if not 0.0 <= hue <= 0.5:
+            # half the color wheel each way is the full hue range; also
+            # keeps sampled deltas inside the native kernel's valid domain
+            raise ValueError(f"hue must be in [0, 0.5], got {hue}")
         self.brightness = brightness
         self.contrast = contrast
         self.saturation = saturation
@@ -175,6 +179,15 @@ class ColorJitter:
 
     def apply_with_params(self, img: Image.Image, p) -> Image.Image:
         arr = np.asarray(img, np.float32)
+
+        from dinov3_tpu.native import color_jitter as native_jitter
+
+        native = native_jitter(
+            np.ascontiguousarray(arr), p["order"],
+            p["brightness"], p["contrast"], p["saturation"], p["hue"],
+        )
+        if native is not None:
+            return Image.fromarray(native.astype(np.uint8))
         for op in p["order"]:
             if op == 0 and p["brightness"] is not None:
                 arr = adjust_brightness(arr, p["brightness"])

@@ -81,6 +81,10 @@ def _load() -> ctypes.CDLL | None:
         lib.stack_crops_f32.argtypes = [
             ctypes.POINTER(f32p), f32p, ctypes.c_int64, ctypes.c_int64,
         ]
+        lib.color_jitter_f32.argtypes = [
+            f32p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ]
         _LIB = lib
         return _LIB
 
@@ -146,3 +150,34 @@ def stack_crops(arrays: list[np.ndarray]) -> np.ndarray | None:
     )
     lib.stack_crops_f32(ptrs, out.ctypes.data_as(f32p), len(contig), item)
     return out
+
+
+def color_jitter(
+    arr_f32: np.ndarray,
+    order,
+    brightness: float | None,
+    contrast: float | None,
+    saturation: float | None,
+    hue: float | None,
+) -> np.ndarray | None:
+    """In-place fused brightness/contrast/saturation/hue on a [H, W, 3]
+    float32 array in [0, 255]; None if native unavailable. ``order`` is a
+    permutation of 0..3; None factors skip that op."""
+    lib = _load()
+    if lib is None:
+        return None
+    if arr_f32.dtype != np.float32 or arr_f32.ndim != 3 \
+            or arr_f32.shape[2] != 3 or not arr_f32.flags.c_contiguous:
+        return None
+    order_arr = np.asarray(order, np.int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.color_jitter_f32(
+        arr_f32.ctypes.data_as(f32p),
+        arr_f32.shape[0] * arr_f32.shape[1],
+        order_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        -1.0 if brightness is None else float(brightness),
+        -1.0 if contrast is None else float(contrast),
+        -1.0 if saturation is None else float(saturation),
+        2.0 if hue is None else float(hue),  # outside [-0.5, 0.5] = skip
+    )
+    return arr_f32

@@ -195,8 +195,9 @@ def do_train(cfg, args) -> dict:
             recorder.record(it, host_metrics)
         if comparator is not None:
             comparator.check(it, host_metrics)
-        if bench_n and it >= total_iters - bench_n:
-            # the metrics fetch above synced, so the step has completed
+        if bench_n and it >= total_iters - bench_n - 1:
+            # the metrics fetch above synced, so the step has completed;
+            # one extra leading timestamp gives N measured intervals
             step_times.append(time.perf_counter())
         if not math.isfinite(last_loss):
             nan_streak += 1

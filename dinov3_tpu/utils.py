@@ -109,12 +109,17 @@ class LossComparator:
 
 def dump_weights(path: str, params) -> None:
     """Flat ``.npz`` dump of a parameter tree ('/'-joined keys) for offline
-    inspection or cross-framework diffing."""
+    inspection or cross-framework diffing. Works on sharded multi-host
+    arrays: shards living on other hosts' devices are gathered first."""
     flat = {}
     for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         name = "/".join(
             str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath
         )
+        if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            leaf = multihost_utils.process_allgather(leaf, tiled=True)
         flat[name] = np.asarray(leaf)
     np.savez(path, **flat)
     logger.info("dumped %d arrays to %s", len(flat), path)

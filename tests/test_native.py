@@ -63,3 +63,55 @@ def test_to_normalized_array_uses_same_semantics_either_path(monkeypatch):
     monkeypatch.setattr(native, "_TRIED", False)
     without = to_normalized_array(img)
     assert np.allclose(with_native, without, atol=1e-5)
+
+
+def test_native_color_jitter_matches_numpy():
+    import numpy as np
+    import pytest
+
+    from dinov3_tpu.data.transforms import (
+        adjust_brightness,
+        adjust_contrast,
+        adjust_hue,
+        adjust_saturation,
+    )
+    from dinov3_tpu.native import color_jitter, native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(0)
+    arr = rng.uniform(0, 255, (64, 48, 3)).astype(np.float32)
+    order = [3, 0, 2, 1]
+    b, c, s, h = 1.3, 0.8, 1.1, 0.21
+
+    ref = arr.copy()
+    for op in order:
+        if op == 0:
+            ref = adjust_brightness(ref, b)
+        elif op == 1:
+            ref = adjust_contrast(ref, c)
+        elif op == 2:
+            ref = adjust_saturation(ref, s)
+        elif op == 3:
+            ref = adjust_hue(ref, h)
+
+    got = color_jitter(arr.copy(), order, b, c, s, h)
+    assert got is not None
+    # identical math modulo float32-vs-float64 intermediates; after the
+    # final uint8 quantization any residual differs by at most 1 level
+    diff = np.abs(got.astype(np.int32).astype(np.float32) - ref)
+    assert np.percentile(diff, 99.9) <= 1.5, diff.max()
+
+
+def test_native_color_jitter_skips_none_factors():
+    import numpy as np
+    import pytest
+
+    from dinov3_tpu.native import color_jitter, native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    arr = np.full((8, 8, 3), 100.0, np.float32)
+    got = color_jitter(arr.copy(), [0, 1, 2, 3], None, None, None, None)
+    assert got is not None
+    np.testing.assert_array_equal(got, arr)
