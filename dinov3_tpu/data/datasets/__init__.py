@@ -6,9 +6,10 @@ from dinov3_tpu.data.datasets.ade20k import ADE20K
 from dinov3_tpu.data.datasets.coco_captions import CocoCaptions
 from dinov3_tpu.data.datasets.image_folder import ImageFolder
 from dinov3_tpu.data.datasets.synthetic_images import SyntheticImages
+from dinov3_tpu.data.datasets.web_shards import WebShards
 
 __all__ = [
     "ImageDataDecoder", "TargetDecoder", "ExtendedVisionDataset",
     "ImageNet", "ImageNet22k", "ADE20K", "CocoCaptions", "SyntheticImages",
-    "ImageFolder",
+    "ImageFolder", "WebShards",
 ]

@@ -1,0 +1,139 @@
+"""Webdataset-style tar-shard dataset for LVD-scale corpora.
+
+(reference analogue: none in the tree — the reference's largest-scale
+storage model was per-class tarballs (image_net_22k.py). BASELINE.json
+config #4 targets "ViT-g + registers on LVD-style webdataset": web-scale
+corpora ship as sequentially-written ``shard-%06d.tar`` files whose
+members are ``<key>.jpg`` / ``<key>.cls`` pairs. This reader keeps that
+contract while staying random-access: each shard's member table is
+indexed from the tar headers once (cached as ``<shard>.idx.npy`` next to
+the shard when the directory is writable), then reads are mmap'd
+zero-copy, so the sampler layer (Epoch/Infinite/ShardedInfinite) works
+unchanged on top — no separate sequential-iterator code path.)
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import mmap
+import os
+import tarfile
+from functools import lru_cache
+from typing import Callable, Optional
+
+import numpy as np
+
+from dinov3_tpu.data.datasets.extended import ExtendedVisionDataset
+
+logger = logging.getLogger("dinov3")
+
+_IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".webp"}
+_INDEX_DTYPE = [
+    ("shard", "<u4"),
+    ("offset", "<u8"),       # payload offset of the image member
+    ("size", "<u8"),
+    ("label", "<i8"),        # -1 when the shard carries no .cls member
+]
+
+
+def _index_shard(path: str) -> list[tuple]:
+    """[(key, offset, size, label)] from one tar's headers."""
+    images: dict[str, tuple[int, int]] = {}
+    labels: dict[str, int] = {}
+    with tarfile.open(path, "r:") as tf:
+        for member in tf:
+            if not member.isfile():
+                continue
+            key, ext = os.path.splitext(member.name)
+            ext = ext.lower()
+            if ext in _IMAGE_EXTS:
+                images[key] = (member.offset_data, member.size)
+            elif ext == ".cls":
+                payload = tf.extractfile(member).read()
+                labels[key] = int(payload.decode().strip() or -1)
+    return [
+        (key, off, size, labels.get(key, -1))
+        for key, (off, size) in sorted(images.items())
+    ]
+
+
+class WebShards(ExtendedVisionDataset):
+    """``root/*.tar`` webdataset shards with random access.
+
+    Dataset-string form: ``WebShards:root=/data/lvd`` (optionally
+    ``:pattern=shard-*.tar``).
+    """
+
+    def __init__(
+        self,
+        *,
+        root: str,
+        pattern: str = "*.tar",
+        split: str = "TRAIN",  # dataset-string compatibility
+        transform: Optional[Callable] = None,
+        target_transform: Optional[Callable] = None,
+        seed: int = 0,
+        mmap_cache_size: int = 16,
+    ):
+        super().__init__(transform, target_transform, seed)
+        self.root = root
+        self.shards = sorted(glob.glob(os.path.join(root, pattern)))
+        if not self.shards:
+            raise FileNotFoundError(f"no {pattern} shards under {root}")
+        self._entries = self._build_index()
+        self._get_mmap = lru_cache(maxsize=mmap_cache_size)(self._open_mmap)
+
+    # ---------------------------------------------------------- index
+
+    def _build_index(self) -> np.ndarray:
+        rows: list[tuple] = []
+        for si, shard in enumerate(self.shards):
+            idx_path = shard + ".idx.npy"
+            if os.path.exists(idx_path) and (
+                os.path.getmtime(idx_path) >= os.path.getmtime(shard)
+            ):
+                part = np.load(idx_path)
+            else:
+                part = np.array(
+                    [(si, off, size, label)
+                     for _, off, size, label in _index_shard(shard)],
+                    dtype=_INDEX_DTYPE,
+                )
+                try:
+                    # atomic publish: concurrent workers may race on the
+                    # cache; never let a reader see a half-written index
+                    # (.npy suffix so np.save keeps the exact path)
+                    tmp = f"{idx_path}.{os.getpid()}.tmp.npy"
+                    np.save(tmp, part)
+                    os.replace(tmp, idx_path)
+                except OSError:
+                    pass  # read-only storage: index stays in memory
+            part = part.copy()
+            part["shard"] = si
+            rows.append(part)
+        entries = np.concatenate(rows)
+        logger.info("WebShards: %d samples across %d shards under %s",
+                    len(entries), len(self.shards), self.root)
+        return entries
+
+    def _open_mmap(self, shard_index: int) -> mmap.mmap:
+        f = open(self.shards[shard_index], "rb")
+        return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+
+    # ------------------------------------------------------- contract
+
+    def get_image_data(self, index: int) -> bytes:
+        row = self._entries[index]
+        m = self._get_mmap(int(row["shard"]))
+        off, size = int(row["offset"]), int(row["size"])
+        return m[off:off + size]
+
+    def get_target(self, index: int) -> int:
+        return int(self._entries[index]["label"])
+
+    def get_targets(self) -> np.ndarray:
+        return self._entries["label"].astype(np.int64)
+
+    def __len__(self) -> int:
+        return len(self._entries)

@@ -69,6 +69,7 @@ def make_dataset(
         "CocoCaptions": D.CocoCaptions,
         "Synthetic": D.SyntheticImages,
         "Folder": D.ImageFolder,
+        "WebShards": D.WebShards,
     }
     if name not in registry:
         raise ValueError(f"unknown dataset {name!r} (have {sorted(registry)})")

@@ -77,13 +77,14 @@ def make_train_pipeline(
 
     dataset_str = cfg.train.dataset_path
     if cfg.data.get("root") and ":root=" not in dataset_str:
-        if (cfg.data.backend == "folder"
-                and dataset_str.split(":")[0] == "Synthetic"):
-            # backend=folder with the default (Synthetic) recipe dataset:
-            # train on a generic class-per-subdirectory ImageFolder. A
-            # recipe that names a real dataset (ImageNet, ...) keeps its
-            # own split/index semantics and only gets rooted.
-            dataset_str = f"Folder:root={cfg.data.root}"
+        if dataset_str.split(":")[0] == "Synthetic":
+            # Synthetic takes no root. With backend=folder the intent is
+            # clearly "train on my directory": swap in the generic
+            # class-per-subdirectory ImageFolder; other backends ignore
+            # the root. A recipe naming a real dataset (ImageNet, ...)
+            # keeps its own split/index semantics and only gets rooted.
+            if cfg.data.backend == "folder":
+                dataset_str = f"Folder:root={cfg.data.root}"
         else:
             dataset_str = f"{dataset_str}:root={cfg.data.root}"
     dataset = make_dataset(dataset_str, transform=transform,

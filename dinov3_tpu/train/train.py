@@ -260,9 +260,11 @@ def do_train(cfg, args) -> dict:
         logger.info("benchmark: %.1f ms/step, %.1f img/s (%d devices)",
                     dt * 1e3, img_s, n_devices)
         result["img_per_sec"] = img_s
-    if args.dump_weights and is_main_process():
+    if args.dump_weights:
         from dinov3_tpu.utils import dump_weights
 
+        # every process participates (the shard gather is a collective);
+        # only the main process writes the file
         dump_weights(args.dump_weights, state.params)
     logger.info("training done at iteration %d, final loss %.4f",
                 int(state.step), result["final_loss"])

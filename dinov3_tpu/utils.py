@@ -109,8 +109,11 @@ class LossComparator:
 
 def dump_weights(path: str, params) -> None:
     """Flat ``.npz`` dump of a parameter tree ('/'-joined keys) for offline
-    inspection or cross-framework diffing. Works on sharded multi-host
-    arrays: shards living on other hosts' devices are gathered first."""
+    inspection or cross-framework diffing.
+
+    Call from EVERY process of a multi-host run: gathering shards that
+    live on other hosts is a collective (all hosts must participate);
+    only process 0 writes the file."""
     flat = {}
     for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         name = "/".join(
@@ -121,5 +124,6 @@ def dump_weights(path: str, params) -> None:
 
             leaf = multihost_utils.process_allgather(leaf, tiled=True)
         flat[name] = np.asarray(leaf)
-    np.savez(path, **flat)
-    logger.info("dumped %d arrays to %s", len(flat), path)
+    if jax.process_index() == 0:
+        np.savez(path, **flat)
+        logger.info("dumped %d arrays to %s", len(flat), path)

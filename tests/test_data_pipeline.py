@@ -305,3 +305,42 @@ def test_image_folder_dataset_and_backend(tmp_path):
     # reachable through the dataset-string registry (data.backend=folder)
     ds2 = make_dataset(f"Folder:root={tmp_path}")
     assert len(ds2) == 6
+
+
+def test_web_shards_dataset(tmp_path):
+    import io
+    import tarfile
+
+    import numpy as np
+    from PIL import Image
+
+    from dinov3_tpu.data.loaders import make_dataset
+
+    rng = np.random.default_rng(0)
+    n_per_shard = 3
+    for si in range(2):
+        with tarfile.open(tmp_path / f"shard-{si:06d}.tar", "w") as tf:
+            for i in range(n_per_shard):
+                key = f"{si}_{i}"
+                buf = io.BytesIO()
+                Image.fromarray(
+                    rng.integers(0, 255, (24, 24, 3), dtype=np.uint8)
+                ).save(buf, format="PNG")
+                data = buf.getvalue()
+                info = tarfile.TarInfo(f"{key}.png")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+                cls = str(si * 10 + i).encode()
+                info = tarfile.TarInfo(f"{key}.cls")
+                info.size = len(cls)
+                tf.addfile(info, io.BytesIO(cls))
+
+    ds = make_dataset(f"WebShards:root={tmp_path}")
+    assert len(ds) == 6
+    img, target = ds[0]
+    assert img.size == (24, 24)
+    assert sorted(ds.get_targets().tolist()) == [0, 1, 2, 10, 11, 12]
+    # header index is cached next to the shards and reused
+    assert (tmp_path / "shard-000000.tar.idx.npy").exists()
+    ds2 = make_dataset(f"WebShards:root={tmp_path}")
+    assert ds2.get_targets().tolist() == ds.get_targets().tolist()
