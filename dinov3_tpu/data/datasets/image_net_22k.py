@@ -9,15 +9,14 @@ preprocessed ``entries`` dumps.)
 
 from __future__ import annotations
 
-import mmap
 import os
 import tarfile
-from functools import lru_cache
 from typing import Callable, Optional
 
 import numpy as np
 
 from dinov3_tpu.data.datasets.extended import ExtendedVisionDataset
+from dinov3_tpu.data.datasets.tar_backed import TarMmapCache
 
 _ENTRIES_DTYPE = [
     ("class_index", "<u4"),
@@ -43,7 +42,10 @@ class ImageNet22k(ExtendedVisionDataset):
         self.extra = extra or os.path.join(root, "extra")
         self._entries: np.ndarray | None = None
         self._tar_names: list[str] | None = None
-        self._get_mmap = lru_cache(maxsize=mmap_cache_size)(self._open_mmap)
+        self._mmaps = TarMmapCache(
+            lambda i: os.path.join(self.root, str(self._tar_names[i])),
+            cache_size=mmap_cache_size,
+        )
 
     # ---------------------------------------------------------- index
 
@@ -84,18 +86,11 @@ class ImageNet22k(ExtendedVisionDataset):
                 self._tar_names = list(np.load(self._tars_path))
         return self._entries
 
-    def _open_mmap(self, tar_index: int) -> mmap.mmap:
-        path = os.path.join(self.root, str(self._tar_names[tar_index]))
-        with open(path, "rb") as f:
-            return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-
     # ------------------------------------------------------------ data
 
     def get_image_data(self, index: int) -> bytes:
         e = self._get_entries()[index]
-        m = self._get_mmap(int(e["tar_index"]))
-        off, size = int(e["offset"]), int(e["size"])
-        return m[off: off + size]
+        return self._mmaps.read(e["tar_index"], e["offset"], e["size"])
 
     def get_target(self, index: int) -> int:
         return int(self._get_entries()[index]["class_index"])

@@ -1,0 +1,33 @@
+"""Shared mmap machinery for tar-backed datasets (IN-22k per-class
+tarballs, webdataset shards): an LRU cache of read-only memory maps with
+zero-copy slice reads. One implementation so fd/cache fixes apply to every
+tar-backed dataset at once."""
+
+from __future__ import annotations
+
+import mmap
+from functools import lru_cache
+from typing import Callable
+
+
+class TarMmapCache:
+    """``read(tar_index, offset, size)`` over lazily-opened, LRU-cached
+    memory maps. ``path_for_index`` resolves a tar index to its file path
+    (lazily — index tables may not be loaded yet at construction).
+
+    Thread-safe under concurrent loader workers: ``mmap`` duplicates the
+    fd, evicted maps close when their refcount drops, and slicing a map is
+    a read-only operation."""
+
+    def __init__(self, path_for_index: Callable[[int], str],
+                 cache_size: int = 16):
+        self._path_for_index = path_for_index
+        self._get = lru_cache(maxsize=cache_size)(self._open)
+
+    def _open(self, tar_index: int) -> mmap.mmap:
+        with open(self._path_for_index(tar_index), "rb") as f:
+            return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+
+    def read(self, tar_index: int, offset: int, size: int) -> bytes:
+        m = self._get(int(tar_index))
+        return m[int(offset):int(offset) + int(size)]

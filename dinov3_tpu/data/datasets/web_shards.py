@@ -16,15 +16,14 @@ from __future__ import annotations
 
 import glob
 import logging
-import mmap
 import os
 import tarfile
-from functools import lru_cache
 from typing import Callable, Optional
 
 import numpy as np
 
 from dinov3_tpu.data.datasets.extended import ExtendedVisionDataset
+from dinov3_tpu.data.datasets.tar_backed import TarMmapCache
 
 logger = logging.getLogger("dinov3")
 
@@ -70,19 +69,32 @@ class WebShards(ExtendedVisionDataset):
         *,
         root: str,
         pattern: str = "*.tar",
-        split: str = "TRAIN",  # dataset-string compatibility
+        split: str = "TRAIN",
         transform: Optional[Callable] = None,
         target_transform: Optional[Callable] = None,
         seed: int = 0,
         mmap_cache_size: int = 16,
     ):
         super().__init__(transform, target_transform, seed)
+        # splits are distinct shard sets: either root/<split>/ exists, or
+        # TRAIN uses root itself. Silently serving the training shards for
+        # a VAL request would score evals on training data.
+        split_dir = os.path.join(root, str(split).lower())
+        if os.path.isdir(split_dir):
+            root = split_dir
+        elif str(split).upper() != "TRAIN":
+            raise FileNotFoundError(
+                f"split={split}: no shard directory {split_dir} "
+                "(non-TRAIN splits need their own shards)"
+            )
         self.root = root
         self.shards = sorted(glob.glob(os.path.join(root, pattern)))
         if not self.shards:
             raise FileNotFoundError(f"no {pattern} shards under {root}")
         self._entries = self._build_index()
-        self._get_mmap = lru_cache(maxsize=mmap_cache_size)(self._open_mmap)
+        self._mmaps = TarMmapCache(
+            lambda i: self.shards[i], cache_size=mmap_cache_size
+        )
 
     # ---------------------------------------------------------- index
 
@@ -117,17 +129,11 @@ class WebShards(ExtendedVisionDataset):
                     len(entries), len(self.shards), self.root)
         return entries
 
-    def _open_mmap(self, shard_index: int) -> mmap.mmap:
-        f = open(self.shards[shard_index], "rb")
-        return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-
     # ------------------------------------------------------- contract
 
     def get_image_data(self, index: int) -> bytes:
         row = self._entries[index]
-        m = self._get_mmap(int(row["shard"]))
-        off, size = int(row["offset"]), int(row["size"])
-        return m[off:off + size]
+        return self._mmaps.read(row["shard"], row["offset"], row["size"])
 
     def get_target(self, index: int) -> int:
         return int(self._entries[index]["label"])

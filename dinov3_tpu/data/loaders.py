@@ -51,6 +51,30 @@ def _parse_dataset_str(dataset_str: str) -> tuple[str, dict]:
     return name, kwargs
 
 
+def resolve_dataset_str(cfg, dataset_str: str | None = None) -> str:
+    """Apply ``cfg.data.root`` / ``cfg.data.backend`` to a dataset string —
+    the single rooting rule shared by the train pipeline and the eval
+    harness (so evals see the same dataset the trainer does).
+
+    Synthetic takes no root: with ``backend=folder`` the intent is "train
+    on my directory" (generic ImageFolder); other backends drop the root
+    with a warning."""
+    dataset_str = dataset_str or cfg.train.dataset_path
+    root = cfg.data.get("root")
+    if not root or ":root=" in dataset_str:
+        return dataset_str
+    if dataset_str.split(":")[0] == "Synthetic":
+        if cfg.data.backend == "folder":
+            return f"Folder:root={root}"
+        logger.warning(
+            "data.root=%s ignored: dataset %r is synthetic and "
+            "data.backend=%r is not 'folder'", root, dataset_str,
+            cfg.data.backend,
+        )
+        return dataset_str
+    return f"{dataset_str}:root={root}"
+
+
 def make_dataset(
     dataset_str: str,
     transform: Optional[Callable] = None,

@@ -21,6 +21,7 @@ from dinov3_tpu.data.loaders import (
     SamplerType,
     make_data_loader,
     make_dataset,
+    resolve_dataset_str,
 )
 
 
@@ -75,18 +76,7 @@ def make_train_pipeline(
     def transform(rng, image):
         return augment(rng, image)
 
-    dataset_str = cfg.train.dataset_path
-    if cfg.data.get("root") and ":root=" not in dataset_str:
-        if dataset_str.split(":")[0] == "Synthetic":
-            # Synthetic takes no root. With backend=folder the intent is
-            # clearly "train on my directory": swap in the generic
-            # class-per-subdirectory ImageFolder; other backends ignore
-            # the root. A recipe naming a real dataset (ImageNet, ...)
-            # keeps its own split/index semantics and only gets rooted.
-            if cfg.data.backend == "folder":
-                dataset_str = f"Folder:root={cfg.data.root}"
-        else:
-            dataset_str = f"{dataset_str}:root={cfg.data.root}"
+    dataset_str = resolve_dataset_str(cfg)
     dataset = make_dataset(dataset_str, transform=transform,
                            seed=cfg.train.seed)
 

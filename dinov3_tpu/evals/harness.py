@@ -57,10 +57,17 @@ def do_eval(
 ) -> dict:
     """Returns {"knn_top1": .., "linear_top1": ..} for the given backbone
     params (normally the EMA teacher's)."""
+    from dinov3_tpu.data.loaders import resolve_dataset_str
+
     ev = cfg.get("evaluation") or {}
-    train_str = train_dataset_str or ev.get("train_dataset_path") or \
-        cfg.train.dataset_path
-    val_str = val_dataset_str or ev.get("val_dataset_path") or train_str
+    # same rooting rule as the train pipeline, so the eval sees the same
+    # dataset the trainer does (data.root applied, backend=folder mapped)
+    train_str = resolve_dataset_str(
+        cfg, train_dataset_str or ev.get("train_dataset_path") or None
+    )
+    val_str = (resolve_dataset_str(
+        cfg, val_dataset_str or ev.get("val_dataset_path"))
+        if (val_dataset_str or ev.get("val_dataset_path")) else train_str)
     size = cfg.crops.global_crops_size
     num_workers = cfg.train.get("num_workers", 8)
 

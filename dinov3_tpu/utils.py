@@ -123,7 +123,10 @@ def dump_weights(path: str, params) -> None:
             from jax.experimental import multihost_utils
 
             leaf = multihost_utils.process_allgather(leaf, tiled=True)
-        flat[name] = np.asarray(leaf)
+        # non-writer hosts only participate in the collective; holding a
+        # full unsharded copy of every param would OOM memory-tight hosts
+        if jax.process_index() == 0:
+            flat[name] = np.asarray(leaf)
     if jax.process_index() == 0:
         np.savez(path, **flat)
         logger.info("dumped %d arrays to %s", len(flat), path)

@@ -344,3 +344,43 @@ def test_web_shards_dataset(tmp_path):
     assert (tmp_path / "shard-000000.tar.idx.npy").exists()
     ds2 = make_dataset(f"WebShards:root={tmp_path}")
     assert ds2.get_targets().tolist() == ds.get_targets().tolist()
+
+
+def test_web_shards_val_split_requires_own_shards(tmp_path):
+    import io
+    import tarfile
+
+    import numpy as np
+    import pytest
+    from PIL import Image
+
+    from dinov3_tpu.data.datasets import WebShards
+
+    rng = np.random.default_rng(0)
+
+    def write_shard(path, n, label0):
+        with tarfile.open(path, "w") as tf:
+            for i in range(n):
+                buf = io.BytesIO()
+                Image.fromarray(
+                    rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+                ).save(buf, format="PNG")
+                data = buf.getvalue()
+                info = tarfile.TarInfo(f"k{i}.png")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+                cls = str(label0 + i).encode()
+                info = tarfile.TarInfo(f"k{i}.cls")
+                info.size = len(cls)
+                tf.addfile(info, io.BytesIO(cls))
+
+    write_shard(tmp_path / "shard-000000.tar", 3, 0)
+    # VAL without its own shards must refuse (not silently serve TRAIN)
+    with pytest.raises(FileNotFoundError):
+        WebShards(root=str(tmp_path), split="VAL")
+    # VAL with a split subdirectory works and is distinct
+    (tmp_path / "val").mkdir()
+    write_shard(tmp_path / "val" / "shard-000000.tar", 2, 100)
+    val = WebShards(root=str(tmp_path), split="VAL")
+    assert len(val) == 2
+    assert sorted(val.get_targets().tolist()) == [100, 101]
