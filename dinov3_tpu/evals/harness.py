@@ -12,7 +12,12 @@ import logging
 import numpy as np
 
 from dinov3_tpu.data.collate import collate_eval
-from dinov3_tpu.data.loaders import SamplerType, make_data_loader, make_dataset
+from dinov3_tpu.data.loaders import (
+    SamplerType,
+    make_data_loader,
+    make_dataset,
+    resolve_dataset_str,
+)
 from dinov3_tpu.data.transforms import (
     make_classification_eval_transform,
     make_classification_train_transform,
@@ -57,17 +62,14 @@ def do_eval(
 ) -> dict:
     """Returns {"knn_top1": .., "linear_top1": ..} for the given backbone
     params (normally the EMA teacher's)."""
-    from dinov3_tpu.data.loaders import resolve_dataset_str
-
     ev = cfg.get("evaluation") or {}
     # same rooting rule as the train pipeline, so the eval sees the same
     # dataset the trainer does (data.root applied, backend=folder mapped)
     train_str = resolve_dataset_str(
         cfg, train_dataset_str or ev.get("train_dataset_path") or None
     )
-    val_str = (resolve_dataset_str(
-        cfg, val_dataset_str or ev.get("val_dataset_path"))
-        if (val_dataset_str or ev.get("val_dataset_path")) else train_str)
+    val_raw = val_dataset_str or ev.get("val_dataset_path")
+    val_str = resolve_dataset_str(cfg, val_raw) if val_raw else train_str
     size = cfg.crops.global_crops_size
     num_workers = cfg.train.get("num_workers", 8)
 

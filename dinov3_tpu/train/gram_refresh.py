@@ -52,3 +52,33 @@ def refresh_gram(state):
     }
     logger.info("gram teacher refreshed from EMA teacher")
     return state._replace(params=new_params)
+
+
+def load_gram_teacher(cfg, state, state_shardings):
+    """gram.backbone <- a prior run's EMA-teacher backbone.
+
+    (reference: ``gram.ckpt`` / ``gram.it_load_ema_teacher`` in
+    ssl_default_config.yaml — declared, consumed nowhere. Here
+    ``gram.ckpt`` names a Checkpointer directory; its **teacher** branch's
+    backbone initializes the frozen gram anchor. ``it_load_ema_teacher``
+    picks the checkpoint step (-1 = latest).)"""
+    path = cfg.gram.get("ckpt")
+    if not path:
+        return state
+    if "gram" not in state.params:
+        raise ValueError(
+            f"gram.ckpt={path} is set but no gram branch exists — "
+            "enable the anchor with gram.use_loss=true"
+        )
+    from dinov3_tpu.train.pretrained import _restore_branch
+
+    step_cfg = cfg.gram.get("it_load_ema_teacher", -1)
+    step = None if step_cfg is None or int(step_cfg) < 0 else int(step_cfg)
+    target = state.params["gram"]
+    shardings = state_shardings.params["gram"]
+    loaded, step_used = _restore_branch(path, "teacher", target, shardings,
+                                        step=step)
+    new_params = dict(state.params)
+    new_params["gram"] = loaded
+    logger.info("gram teacher loaded from %s step %d", path, step_used)
+    return state._replace(params=new_params)

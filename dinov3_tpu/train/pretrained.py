@@ -57,17 +57,19 @@ def _merge_restored(dst, src):
     return src
 
 
-def _restore_branch(path: str, branch: str, target, target_shardings):
+def _restore_branch(path: str, branch: str, target, target_shardings,
+                    step: int | None = None):
     """Restore ``params[branch]`` from the checkpoint at ``path``, shaped
     and sharded like ``target``; leaves missing from the checkpoint — or
     saved with different shapes (head prototype counts differ across
-    recipes) — keep their ``target`` values."""
+    recipes) — keep their ``target`` values. ``step`` picks a checkpoint
+    (default: latest)."""
     import orbax.checkpoint as ocp
 
     with ocp.CheckpointManager(
         path, item_handlers={"state": ocp.PyTreeCheckpointHandler()}
     ) as manager:
-        step = manager.latest_step()
+        step = step if step is not None else manager.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {path}")
         meta = manager.item_metadata(step)["state"].tree

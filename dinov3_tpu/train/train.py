@@ -125,6 +125,12 @@ def do_train(cfg, args) -> dict:
         from dinov3_tpu.train.pretrained import load_pretrained_weights
 
         state = load_pretrained_weights(cfg, state, setup.state_shardings)
+    if start_iter == 0 and cfg.gram.get("ckpt"):
+        # fresh run with an external gram anchor (gram-anchor phase):
+        # the frozen gram backbone comes from a prior run's EMA teacher
+        from dinov3_tpu.train.gram_refresh import load_gram_teacher
+
+        state = load_gram_teacher(cfg, state, setup.state_shardings)
 
     prof = None
     if args.profile_steps:

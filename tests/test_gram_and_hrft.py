@@ -99,3 +99,57 @@ def test_hrft_params_only_restore(tmp_path):
     got = jax.tree.leaves(restored.params["student"])
     for w, g in zip(want, got):
         assert np.allclose(np.asarray(w), np.asarray(g))
+
+
+def test_load_gram_teacher_from_checkpoint(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dinov3_tpu.checkpoint import Checkpointer
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.data import make_synthetic_batch
+    from dinov3_tpu.train import build_train_setup, put_batch
+    from dinov3_tpu.train.gram_refresh import load_gram_teacher
+
+    smol = [
+        "student.arch=vit_test", "student.patch_size=4",
+        "student.drop_path_rate=0.0",
+        "crops.global_crops_size=16", "crops.local_crops_size=8",
+        "crops.local_crops_number=2",
+        "dino.head_n_prototypes=64", "dino.head_hidden_dim=32",
+        "dino.head_bottleneck_dim=16",
+        "ibot.head_n_prototypes=64", "ibot.head_hidden_dim=32",
+        "ibot.head_bottleneck_dim=16",
+        "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=4",
+        "optim.scaling_rule=none",
+    ]
+    # teacher pretraining run -> checkpoint
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, smol)
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, 4, seed=0).items()}
+    setup = build_train_setup(cfg, batch)
+    dbatch = put_batch(batch, setup.batch_shardings)
+    state, _ = setup.step_fn(setup.state, dbatch, setup.scalars(0),
+                             jax.random.key(0))
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), max_to_keep=1)
+    ckpt.save(1, state)
+    ckpt.wait_until_finished()
+    ckpt.close()
+    teacher_leaf = np.asarray(
+        jax.tree.leaves(state.params["teacher"]["backbone"])[0])
+
+    # gram-anchor run: gram backbone loads the prior EMA teacher
+    cfg2 = get_default_config()
+    apply_dot_overrides(cfg2, smol + [
+        "gram.use_loss=true", f"gram.ckpt={tmp_path / 'ckpt'}",
+        "gram.it_load_ema_teacher=-1",
+    ])
+    batch2 = {k: jnp.asarray(v) for k, v in
+              make_synthetic_batch(cfg2, 4, seed=1).items()}
+    setup2 = build_train_setup(cfg2, batch2)
+    assert "gram" in setup2.state.params
+    state2 = load_gram_teacher(cfg2, setup2.state, setup2.state_shardings)
+    got = np.asarray(jax.tree.leaves(state2.params["gram"]["backbone"])[0])
+    np.testing.assert_allclose(got, teacher_leaf)

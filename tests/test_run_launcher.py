@@ -113,3 +113,43 @@ def test_local_launcher_fails_fast_on_child_error(tmp_path):
     assert raised
     # far less than the 300s deadline: the group was killed on first failure
     assert time.monotonic() - t0 < 120
+
+
+def test_local_launcher_multiprocess_training(tmp_path):
+    """Two coordinated processes form a data=2 mesh and train end-to-end —
+    the multi-host path the reference stubbed out (its get_rank() was
+    hardcoded to 0, SURVEY.md §2.5)."""
+    from dinov3_tpu.run import LocalLauncher
+
+    target = tmp_path / "train2.py"
+    target.write_text(
+        "def main(argv):\n"
+        "    import jax\n"
+        "    assert jax.process_count() == 2\n"
+        "    from dinov3_tpu.train.train import main as train_main\n"
+        "    out = train_main(argv)\n"
+        "    assert out['iterations'] == 2, out\n"
+        "    import pathlib\n"
+        "    pathlib.Path(argv[1] + f'/ok{jax.process_index()}').touch()\n"
+    )
+    run_dir = tmp_path / "run"
+    LocalLauncher(2, port=12481).launch(
+        str(target),
+        [
+            "--output-dir", str(run_dir),
+            "--no-resume",
+            "student.arch=vit_test", "student.patch_size=4",
+            "crops.global_crops_size=16", "crops.local_crops_size=8",
+            "crops.local_crops_number=2",
+            "dino.head_n_prototypes=64", "dino.head_hidden_dim=32",
+            "dino.head_bottleneck_dim=16",
+            "ibot.head_n_prototypes=64", "ibot.head_hidden_dim=32",
+            "ibot.head_bottleneck_dim=16",
+            "train.batch_size_per_device=2",
+            "train.OFFICIAL_EPOCH_LENGTH=2",
+            "optim.epochs=1", "optim.warmup_epochs=0",
+            "optim.scaling_rule=none", "data.backend=synthetic",
+        ],
+        timeout_s=420.0,
+    )
+    assert (run_dir / "ok0").exists() and (run_dir / "ok1").exists()
