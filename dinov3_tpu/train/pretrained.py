@@ -69,9 +69,15 @@ def _restore_branch(path: str, branch: str, target, target_shardings,
     with ocp.CheckpointManager(
         path, item_handlers={"state": ocp.PyTreeCheckpointHandler()}
     ) as manager:
-        step = step if step is not None else manager.latest_step()
         if step is None:
-            raise FileNotFoundError(f"no checkpoint under {path}")
+            step = manager.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint under {path}")
+        elif step not in manager.all_steps():
+            raise FileNotFoundError(
+                f"checkpoint step {step} not found under {path} "
+                f"(available: {sorted(manager.all_steps())})"
+            )
         meta = manager.item_metadata(step)["state"].tree
         saved_branch = (meta.get("params") or {}).get(branch)
         if saved_branch is None:

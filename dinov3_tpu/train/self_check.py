@@ -1,0 +1,84 @@
+"""One-batch training self-check: does every part of the SSL step move?
+
+(reference: the ``--test-ibot`` debug flag of dinov3_jax/train/train.py:63
+— declared, parsed, and never referenced again (SURVEY.md §4.4). This is
+the working generalization: run two real steps on one batch and assert
+the properties that silently break in practice — per-loss finiteness,
+every student submodule receiving gradient, the teacher actually tracking
+the student (the reference's EMA never fed back, §2.9.1), and the frozen
+branches staying frozen.)
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+logger = logging.getLogger("dinov3")
+
+
+def _to_host(leaf) -> np.ndarray:
+    """Device leaf -> host array; shards on other hosts' devices are
+    gathered first (a collective — every process runs the self-check)."""
+    if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    return np.asarray(leaf, np.float32)
+
+
+def _tree_delta(before_host, after_device) -> float:
+    """Mean absolute change across all leaves (one leaf on host at a
+    time — no second full host copy of the parameter tree)."""
+    deltas = jax.tree.map(
+        lambda a, b: float(np.mean(np.abs(_to_host(b) - a))),
+        before_host, after_device,
+    )
+    leaves = jax.tree.leaves(deltas)
+    return float(np.mean(leaves)) if leaves else 0.0
+
+
+def run_self_check(setup, batch, rng) -> dict:
+    """Returns {check_name: ok}; logs a human-readable verdict table."""
+    state0 = setup.state
+    params0 = jax.tree.map(_to_host, state0.params)
+    state1, metrics1 = setup.step_fn(state0, batch, setup.scalars(0), rng)
+    state2, metrics2 = setup.step_fn(state1, batch, setup.scalars(1), rng)
+
+    results: dict = {}
+    for key, value in metrics2.items():
+        if key.endswith("loss"):
+            results[f"finite:{key}"] = bool(np.isfinite(float(value)))
+
+    params2 = state2.params
+    for name, sub in params2["student"].items():
+        moved = _tree_delta(params0["student"][name], sub)
+        results[f"student_updates:{name}"] = moved > 0.0
+    # the EMA teacher must track the student (frozen-teacher bug class);
+    # under distillation the teacher is a frozen pretrained model instead
+    if getattr(setup.meta, "distillation", False):
+        frozen = _tree_delta(params0["teacher"], params2["teacher"]) == 0.0
+        results["distillation_teacher_frozen"] = frozen
+    else:
+        for name, sub in params2["teacher"].items():
+            if name in params0["teacher"]:
+                moved = _tree_delta(params0["teacher"][name], sub)
+                results[f"teacher_ema_moves:{name}"] = moved > 0.0
+    # the gram anchor is frozen between explicit refreshes
+    if "gram" in params2:
+        frozen = _tree_delta(params0["gram"], params2["gram"]) == 0.0
+        results["gram_frozen_between_refreshes"] = frozen
+    results["step_counter_advances"] = int(state2.step) == 2
+
+    width = max(len(k) for k in results)
+    lines = [f"  {k:<{width}}  {'ok' if v else 'FAIL'}"
+             for k, v in sorted(results.items())]
+    logger.info("self-check:\n%s", "\n".join(lines))
+    n_fail = sum(not v for v in results.values())
+    if n_fail:
+        logger.error("self-check: %d/%d checks FAILED", n_fail, len(results))
+    else:
+        logger.info("self-check: all %d checks passed", len(results))
+    return results

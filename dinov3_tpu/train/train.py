@@ -61,6 +61,10 @@ def get_args_parser():
     p.add_argument("--benchmark", type=int, default=0, metavar="N",
                    help="measure steady-state step time over the last N "
                         "iterations and log img/s")
+    p.add_argument("--self-check", action="store_true",
+                   help="run two diagnostic steps on one batch (losses "
+                        "finite, every submodule trains, teacher EMA "
+                        "tracks) and exit")
     p.add_argument("opts", nargs="*", default=[],
                    help="key.path=value config overrides")
     return p
@@ -95,6 +99,16 @@ def do_train(cfg, args) -> dict:
         "mesh %s | global batch %d | %d devices | setup %.1fs",
         dict(setup.mesh.shape), B, n_devices, time.perf_counter() - t0,
     )
+
+    if args.self_check:
+        from dinov3_tpu.train.self_check import run_self_check
+
+        results = run_self_check(
+            setup, put_batch(first, setup.batch_shardings),
+            jax.random.key(cfg.train.seed + 1),
+        )
+        return {"self_check_failures": sum(not v for v in results.values()),
+                **{f"check/{k}": v for k, v in results.items()}}
 
     total_iters = cfg.optim.epochs * cfg.train.OFFICIAL_EPOCH_LENGTH
     if args.max_iterations > 0:
@@ -289,4 +303,7 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main(sys.argv[1:])
+    result = main(sys.argv[1:])
+    # CI gating: `--self-check && launch` must fail on a failing model
+    if result and result.get("self_check_failures"):
+        sys.exit(1)

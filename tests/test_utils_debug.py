@@ -87,3 +87,15 @@ def test_trainer_record_compare_benchmark_flags(tmp_path):
         *SMOL,
     ])
     assert out2["loss_divergences"] == 0
+
+
+def test_self_check_flag(tmp_path):
+    from dinov3_tpu.train.train import main
+
+    out = main([
+        "--output-dir", str(tmp_path / "sc"), "--self-check", "--no-resume",
+        *SMOL,
+    ])
+    assert out["self_check_failures"] == 0
+    assert out["check/step_counter_advances"] is True
+    assert any(k.startswith("check/teacher_ema_moves") for k in out)
