@@ -31,8 +31,10 @@ def gram_loss(
     s = student_feats.astype(reduce_dtype)
     t = teacher_feats.astype(reduce_dtype)
     if normalize:
-        s = s / (jnp.linalg.norm(s, axis=-1, keepdims=True) + 1e-12)
-        t = t / (jnp.linalg.norm(t, axis=-1, keepdims=True) + 1e-12)
+        from dinov3_tpu.ops.common import l2_normalize
+
+        s = l2_normalize(s)  # zero-safe gradient (ops/common.py)
+        t = l2_normalize(t)
     if not img_level:
         s = s.reshape(-1, s.shape[-1])
         t = t.reshape(-1, t.shape[-1])

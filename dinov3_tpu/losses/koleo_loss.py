@@ -32,7 +32,9 @@ def koleo_loss(
         raise ValueError(f"group_size {g} must divide batch {B}")
     if g < 2:
         raise ValueError("koleo needs at least 2 samples per group")
-    x = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + eps)
+    from dinov3_tpu.ops.common import l2_normalize
+
+    x = l2_normalize(x, eps=eps)  # zero-safe gradient (ops/common.py)
     xg = x.reshape(B // g, g, D)
     sims = jnp.einsum("gbd,gcd->gbc", xg, xg)
     # exclude self-pairs

@@ -64,6 +64,8 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
     kw["attn_impl"] = kernels.get("flash_attention", "auto")
     parallel = cfg.get("parallel") or {}
     kw["seq_parallel"] = int(parallel.get("seq", 1) or 1) > 1
+    kw["pipeline_stages"] = int(parallel.get("pipe", 1) or 1)
+    kw["pipeline_microbatches"] = int(parallel.get("pipe_microbatches", 0) or 0)
     kw["scan_layers"] = bool(train.get("scan_layers", False))
     policy = Policy.from_cfg(cfg.compute_precision)
     kw["dtype"] = policy.compute_dtype

@@ -27,7 +27,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from dinov3_tpu.ops.block import SelfAttentionBlock
+from dinov3_tpu.ops.block import ScanBlockAdapter, SelfAttentionBlock
 from dinov3_tpu.ops.common import canonical_dtype, part
 from dinov3_tpu.ops.norms import make_norm_layer
 from dinov3_tpu.ops.patch_embed import PatchEmbed
@@ -36,26 +36,6 @@ from dinov3_tpu.ops.rope import (
     rope_sincos,
     rope_with_identity_prefix,
 )
-
-
-class _ScanBlock(nn.Module):
-    """Adapter giving SelfAttentionBlock the (carry, ys) scan contract."""
-
-    block_kwargs: dict
-    remat: str = "none"
-
-    @nn.compact
-    def __call__(self, x, rope, deterministic: bool):
-        block_cls = SelfAttentionBlock
-        if self.remat in ("blocks", "full"):
-            block_cls = nn.remat(
-                block_cls,
-                static_argnums=(3,),
-                policy=(None if self.remat == "full"
-                        else jax.checkpoint_policies.dots_with_no_batch_dims_saveable),
-            )
-        x = block_cls(**self.block_kwargs, name="block")(x, rope, deterministic)
-        return x, None
 
 
 class DinoVisionTransformer(nn.Module):
@@ -90,6 +70,8 @@ class DinoVisionTransformer(nn.Module):
     attn_impl: str = "auto"
     seq_parallel: bool = False
     scan_layers: bool = False
+    pipeline_stages: int = 1       # >1: GPipe pipeline over the pipe axis
+    pipeline_microbatches: int = 0  # 0 = pipeline_stages
     remat: str = "none"  # none | blocks | full
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -186,9 +168,20 @@ class DinoVisionTransformer(nn.Module):
     def _run_blocks(self, x, rope, deterministic, collect: Sequence[int] = ()):
         """Run the stack; optionally collect outputs of the listed layers."""
         collected = {}
-        if self.scan_layers and not collect:
+        if self.pipeline_stages > 1 and not collect:
+            from dinov3_tpu.parallel.pipeline import PipelinedBlocks
+
+            x = PipelinedBlocks(
+                block_kwargs=self._block_kwargs(),
+                n_blocks=self.n_blocks,
+                n_stages=self.pipeline_stages,
+                n_microbatches=self.pipeline_microbatches,
+                remat=self.remat,
+                name="pipeline",
+            )(x, rope, deterministic)
+        elif self.scan_layers and not collect:
             scanned = nn.scan(
-                _ScanBlock,
+                ScanBlockAdapter,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "drop_path": True, "dropout": True},
                 in_axes=(nn.broadcast, nn.broadcast),
@@ -301,9 +294,10 @@ class DinoVisionTransformer(nn.Module):
     ):
         """Eval-time feature extraction (reference:280-312, with its reshape
         and index typos fixed)."""
-        if self.scan_layers:
+        if self.scan_layers or self.pipeline_stages > 1:
             raise NotImplementedError(
-                "get_intermediate_layers requires scan_layers=False"
+                "get_intermediate_layers requires scan_layers=False and "
+                "pipeline_stages=1"
             )
         tokens, (h, w) = self._prepare_tokens(x, None)
         rope = self._rope_table(h, w, True)

@@ -71,3 +71,26 @@ class SelfAttentionBlock(nn.Module):
           deterministic=deterministic)
         x = x + dp(ls("ls2")(ffn_out), deterministic=deterministic)
         return x
+
+class ScanBlockAdapter(nn.Module):
+    """(carry, ys) scan contract for SelfAttentionBlock, shared by the
+    scan-over-blocks model path (models/vision_transformer.py) and the
+    pipeline stages (dinov3_tpu/parallel/pipeline.py)."""
+
+    block_kwargs: dict
+    remat: str = "none"
+
+    @nn.compact
+    def __call__(self, x, rope, deterministic: bool):
+        import jax
+
+        block_cls = SelfAttentionBlock
+        if self.remat in ("blocks", "full"):
+            block_cls = nn.remat(
+                block_cls,
+                static_argnums=(3,),
+                policy=(None if self.remat == "full"
+                        else jax.checkpoint_policies.dots_with_no_batch_dims_saveable),
+            )
+        x = block_cls(**self.block_kwargs, name="block")(x, rope, deterministic)
+        return x, None

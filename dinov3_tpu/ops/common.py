@@ -64,6 +64,21 @@ def trunc_normal_init(stddev: float = 0.02) -> Callable:
     )
 
 
+def l2_normalize(x: jnp.ndarray, axis: int = -1, eps: float = 1e-12) -> jnp.ndarray:
+    """L2 normalize with a gradient that is finite at x == 0.
+
+    ``x / (||x|| + eps)`` has a well-defined value at zero but d||x||/dx is
+    0/0 there, so the backward pass produces NaN the moment any normalized
+    vector is exactly zero (e.g. a fully-dropped-path sample whose masked
+    tokens are the zero-init mask_token fed through zero-init biases).
+    Putting eps inside the sqrt keeps value AND gradient finite.
+    """
+    import jax
+
+    sq = jnp.sum(jnp.square(x), axis=axis, keepdims=True)
+    return x * jax.lax.rsqrt(sq + eps * eps)
+
+
 def part(init: Callable, names: Sequence[str | None]) -> Callable:
     """Attach logical partition names to a param initializer."""
     return nn.with_logical_partitioning(init, tuple(names))

@@ -18,7 +18,7 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from dinov3_tpu.ops.common import part, trunc_normal_init
+from dinov3_tpu.ops.common import l2_normalize, part, trunc_normal_init
 
 
 class DINOHead(nn.Module):
@@ -55,10 +55,9 @@ class DINOHead(nn.Module):
                     x = dense(self.hidden_dim, f"mlp_{i}", ("mlp", None))(x)
                     x = nn.gelu(x)
                 x = dense(self.bottleneck_dim, f"mlp_{n-1}", ("mlp", None))(x)
-            # L2 normalize in fp32 (eps as in reference dino_head.py:80-82)
-            xf = x.astype(self.reduce_dtype)
-            norm = jnp.linalg.norm(xf, ord=2, axis=-1, keepdims=True)
-            x = (xf / (norm + 1e-12)).astype(self.dtype)
+            # L2 normalize in fp32 (reference dino_head.py:80-82), with the
+            # zero-safe gradient form (ops/common.py l2_normalize)
+            x = l2_normalize(x.astype(self.reduce_dtype)).astype(self.dtype)
         if skip_last_layer:
             return x
         prototypes = self.param(
@@ -67,5 +66,5 @@ class DINOHead(nn.Module):
         )
         w = prototypes.astype(self.reduce_dtype)
         if self.norm_last_layer:
-            w = w / (jnp.linalg.norm(w, axis=0, keepdims=True) + 1e-12)
+            w = l2_normalize(w, axis=0)
         return (x.astype(self.reduce_dtype) @ w).astype(self.reduce_dtype)

@@ -4,7 +4,7 @@ Replaces the reference's hand-rolled per-module FSDP interceptor and
 single-axis "dp" shard_map program (dinov3_jax/fsdp/utils.py:19-110,
 dinov3_jax/train/train.py:322-354) with the TPU-native design from
 SURVEY.md §7.1: one global mesh with named axes
-``(dcn_data, data, fsdp, seq, tensor)``, parameters born sharded via
+``(dcn_data, data, pipe, fsdp, seq, tensor)``, parameters born sharded via
 ``NamedSharding``, and XLA's SPMD partitioner inserting all collectives.
 """
 
@@ -20,6 +20,7 @@ from dinov3_tpu.parallel.distributed import (
     process_index,
 )
 from dinov3_tpu.parallel.mesh import MeshSpec, build_mesh
+from dinov3_tpu.parallel.pipeline import PipelinedBlocks, pipe_axis_size
 from dinov3_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_local,
@@ -39,6 +40,8 @@ __all__ = [
     "get_current_mesh",
     "set_current_mesh",
     "seq_axis_size",
+    "PipelinedBlocks",
+    "pipe_axis_size",
     "ring_attention",
     "ring_attention_local",
     "initialize_distributed",

@@ -19,8 +19,10 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 # Outer-to-inner order: DCN (slowest) first, tensor (fastest / most
-# communication per byte) last.
-AXES = ("dcn_data", "data", "fsdp", "seq", "tensor")
+# communication per byte) last. ``pipe`` (pipeline stages) sits between
+# data and fsdp: its per-microbatch point-to-point transfers are lighter
+# than FSDP all-gathers but heavier than gradient reductions.
+AXES = ("dcn_data", "data", "pipe", "fsdp", "seq", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,6 +33,7 @@ class MeshSpec:
     fsdp: int = 1
     tensor: int = 1
     seq: int = 1
+    pipe: int = 1
     dcn_data: int = 1
 
     @classmethod
@@ -40,28 +43,30 @@ class MeshSpec:
             fsdp=int(parallel_cfg.get("fsdp", 1)),
             tensor=int(parallel_cfg.get("tensor", 1)),
             seq=int(parallel_cfg.get("seq", 1)),
+            pipe=int(parallel_cfg.get("pipe", 1)),
             dcn_data=int(parallel_cfg.get("dcn_data", 1)),
         )
 
     def resolve(self, n_devices: int) -> tuple[int, ...]:
-        """Concrete (dcn_data, data, fsdp, seq, tensor) sizes."""
-        fixed = self.dcn_data * self.fsdp * self.seq * self.tensor
+        """Concrete (dcn_data, data, pipe, fsdp, seq, tensor) sizes."""
+        fixed = self.dcn_data * self.pipe * self.fsdp * self.seq * self.tensor
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
                     f"{n_devices} devices not divisible by "
-                    f"dcn*fsdp*seq*tensor={fixed}"
+                    f"dcn*pipe*fsdp*seq*tensor={fixed}"
                 )
             data = n_devices // fixed
         total = fixed * data
         if total != n_devices:
-            sizes = dict(dcn_data=self.dcn_data, data=data, fsdp=self.fsdp,
-                         seq=self.seq, tensor=self.tensor)
+            sizes = dict(dcn_data=self.dcn_data, data=data, pipe=self.pipe,
+                         fsdp=self.fsdp, seq=self.seq, tensor=self.tensor)
             raise ValueError(
                 f"mesh {sizes} needs {total} devices, have {n_devices}"
             )
-        return (self.dcn_data, data, self.fsdp, self.seq, self.tensor)
+        return (self.dcn_data, data, self.pipe, self.fsdp, self.seq,
+                self.tensor)
 
 
 def build_mesh(
@@ -85,7 +90,7 @@ def build_mesh(
             per_slice = tuple(s for s in shape[1:])
             mesh_devices = mesh_utils.create_hybrid_device_mesh(
                 (1,) + per_slice,
-                dcn_mesh_shape=(dcn, 1, 1, 1, 1),
+                dcn_mesh_shape=(dcn,) + (1,) * len(per_slice),
                 devices=devices,
             )
         else:

@@ -1,0 +1,191 @@
+"""GPipe-style pipeline parallelism for the transformer block stack.
+
+The reference has no pipeline parallelism (SURVEY.md §2.5 checklist: "PP —
+absent"); this is a TPU-native addition for depth-dominated models
+(ViT-g/7B) where FSDP alone leaves the per-layer all-gather on the
+critical path.
+
+Design (GSPMD collective pipeline, no shard_map):
+
+- Block params are stacked ``[n_stages, blocks_per_stage, ...]`` via
+  ``nn.vmap`` (stage axis) over ``nn.scan`` (blocks within a stage). The
+  stage axis carries the logical name "stages", mapped to the ``pipe`` mesh
+  axis (parallel/sharding.py) — each pipe device owns exactly one stage's
+  params, like a Megatron/GPipe stage rank.
+- The batch is split into M microbatches. An ``nn.scan`` over
+  ``M + n_stages - 1`` ticks (params broadcast, drop-path RNG split per
+  tick) carries a stage-input buffer ``[n_stages, mb, N, D]`` whose leading
+  axis is sharded over ``pipe``; every tick all stages run concurrently on
+  their current microbatch (the vmapped stage apply partitions elementwise
+  over the pipe axis), then the buffer shifts one stage down (the
+  concatenate of the new feed with ``buf[:-1]`` is a shift along the
+  sharded stage axis -> XLA collective-permute over ICI neighbors).
+- Ticks ``t >= n_stages - 1`` emit the last stage's output; the first
+  ``n_stages - 1`` ticks of each buffer are pipeline bubble, exactly as in
+  GPipe. Waste fraction = (S-1)/(M+S-1); raise
+  ``parallel.pipe_microbatches`` to amortize.
+
+Autodiff flows through the scan and the shifts (collective-permute
+transposes to the reverse permute), so the same schedule serves the
+backward pass; grads for each stage land sharded on its own device.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dinov3_tpu.parallel.context import get_current_mesh
+
+
+def pipe_axis_size() -> int:
+    mesh = get_current_mesh()
+    if mesh is None or "pipe" not in mesh.shape:
+        return 1
+    return int(mesh.shape["pipe"])
+
+
+def _constrain_stage_buffer(x: jnp.ndarray) -> jnp.ndarray:
+    """Pin the [stage, mb, N, D] buffer: stage axis on pipe, batch axis on
+    the data axes. Uses the concrete mesh (static at trace time) because
+    flax logical rules are not in scope inside the train-step jit."""
+    mesh = get_current_mesh()
+    if mesh is None or int(mesh.shape.get("pipe", 1)) <= 1:
+        return x
+    dp = 1
+    for a in ("dcn_data", "data", "fsdp"):
+        dp *= int(mesh.shape.get(a, 1))
+    # the microbatch dim stays split over the data axes only when it
+    # divides evenly (tiny test shapes may not); every other dim is left
+    # UNCONSTRAINED so GSPMD propagation (e.g. a seq-sharded token axis
+    # under ring attention) is not overridden to replicated
+    U = P.UNCONSTRAINED
+    batch_axes = ("dcn_data", "data", "fsdp") if x.shape[1] % dp == 0 else U
+    spec = P("pipe", batch_axes, *([U] * (x.ndim - 2)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+class _Stage(nn.Module):
+    """One pipeline stage: a scan over its blocks_per_stage blocks."""
+
+    block_kwargs: dict
+    blocks_per_stage: int
+    remat: str = "none"
+
+    @nn.compact
+    def __call__(self, x, rope, deterministic: bool):
+        from dinov3_tpu.ops.block import ScanBlockAdapter
+
+        scanned = nn.scan(
+            ScanBlockAdapter,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "drop_path": True, "dropout": True},
+            in_axes=(nn.broadcast, nn.broadcast),
+            length=self.blocks_per_stage,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(block_kwargs=self.block_kwargs, remat=self.remat, name="blocks")
+        x, _ = scanned(x, rope, deterministic)
+        return x
+
+
+class _Tick(nn.Module):
+    """One pipeline tick: feed a microbatch into stage 0, run all stages
+    concurrently, shift the buffer, collect the last stage's emission."""
+
+    block_kwargs: dict
+    n_stages: int
+    blocks_per_stage: int
+    n_microbatches: int
+    remat: str = "none"
+
+    @nn.compact
+    def __call__(self, carry, t, micro, rope, deterministic: bool):
+        buf, out = carry         # [S, mb, N, D], [M, mb, N, D]
+        S, M = self.n_stages, self.n_microbatches
+        # microbatch t enters stage 0 at tick t; drain ticks re-feed the
+        # last microbatch (their results never surface)
+        feed = jax.lax.dynamic_index_in_dim(
+            micro, jnp.minimum(t, M - 1), keepdims=False
+        )
+
+        stages = nn.vmap(
+            _Stage,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "drop_path": True, "dropout": True},
+            in_axes=(0, None, None),
+            out_axes=0,
+            axis_size=S,
+            metadata_params={nn.PARTITION_NAME: "stages"},
+        )(
+            block_kwargs=self.block_kwargs,
+            blocks_per_stage=self.blocks_per_stage,
+            remat=self.remat,
+            name="stages",
+        )
+
+        buf = _constrain_stage_buffer(
+            jnp.concatenate([feed[None], buf[:-1]], axis=0)
+        )
+        ran = _constrain_stage_buffer(stages(buf, rope, deterministic))
+        slot = jnp.clip(t - (S - 1), 0, M - 1)
+        emit = jnp.where(t >= S - 1, ran[-1], out[slot])
+        out = jax.lax.dynamic_update_index_in_dim(out, emit, slot, 0)
+        return (ran, out), None
+
+
+class PipelinedBlocks(nn.Module):
+    """The full block stack, run as an S-stage GPipe pipeline.
+
+    Call: ``(x [B, N, D], rope, deterministic) -> [B, N, D]``.
+    ``n_microbatches`` must divide B; 0 means ``n_stages`` microbatches.
+    """
+
+    block_kwargs: dict
+    n_blocks: int
+    n_stages: int
+    n_microbatches: int = 0
+    remat: str = "none"
+
+    @nn.compact
+    def __call__(self, x, rope, deterministic: bool):
+        S = self.n_stages
+        if self.n_blocks % S != 0:
+            raise ValueError(
+                f"n_blocks={self.n_blocks} not divisible by n_stages={S}"
+            )
+        M = self.n_microbatches or S
+        B, N, D = x.shape
+        if B < M:
+            # tiny batches (init traces, smoke shapes) can't fill the
+            # schedule; degrade to per-sample microbatches — same math
+            M = B
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by n_microbatches={M}")
+        mb = B // M
+        T = M + S - 1
+
+        micro = x.reshape(M, mb, N, D)
+
+        tick = nn.scan(
+            _Tick,
+            variable_broadcast="params",
+            split_rngs={"params": False, "drop_path": True, "dropout": True},
+            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast),
+            length=T,
+        )(
+            block_kwargs=self.block_kwargs,
+            n_stages=S,
+            blocks_per_stage=self.n_blocks // S,
+            n_microbatches=M,
+            remat=self.remat,
+            name="tick",
+        )
+
+        buf0 = _constrain_stage_buffer(jnp.zeros((S, mb, N, D), x.dtype))
+        out0 = jnp.zeros((M, mb, N, D), x.dtype)
+        (_, out), _ = tick(
+            (buf0, out0), jnp.arange(T), micro, rope, deterministic
+        )
+        return out.reshape(B, N, D)

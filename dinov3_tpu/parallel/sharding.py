@@ -36,6 +36,13 @@ DEFAULT_LOGICAL_RULES = (
     ("mlp", "tensor"),
     ("vocab", "tensor"),
     ("embed_act", None),
+    # pipeline parallelism: the leading stage axis of stage-stacked block
+    # params ([n_stages, blocks_per_stage, ...], parallel/pipeline.py) lives
+    # on the pipe mesh axis; each pipe device holds and runs its own stage.
+    ("stages", "pipe"),
+    # scan-over-blocks layer axis stays replicated (sharding it would be
+    # FSDP-along-depth: an all-gather per use, not a pipeline).
+    ("layers", None),
 )
 
 

@@ -197,3 +197,51 @@ def test_gram_default_config_allowed():
     # default False/False config (SURVEY.md §2.9.6); we accept it.
     s = jax.random.normal(jax.random.key(0), (1, 4, 4))
     assert np.isfinite(np.asarray(gram_loss(s, s + 0.1)))
+
+
+# ---------------- zero-safe gradients ----------------
+
+def test_l2_normalize_zero_gradient_finite():
+    """Gradient of l2_normalize is finite at x == 0 (the x/(||x||+eps) form
+    NaNs there; caught live: a fully-dropped-path sample's masked tokens are
+    exactly the zero-init mask_token, which reaches the DINO head bottleneck
+    as an all-zero vector)."""
+    from dinov3_tpu.ops.common import l2_normalize
+
+    def f(x):
+        return jnp.sum(l2_normalize(x) ** 2)
+
+    g = jax.grad(f)(jnp.zeros((4, 8)))
+    assert bool(jnp.isfinite(g).all())
+    # nonzero rows still normalize to unit length with correct gradient
+    x = jax.random.normal(jax.random.key(0), (4, 8))
+    y = l2_normalize(x)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(y), axis=-1), 1.0, atol=1e-5
+    )
+    g = jax.grad(f)(x)
+    assert bool(jnp.isfinite(g).all())
+
+
+def test_dino_head_zero_input_gradient_finite():
+    """A zero feature row through DINOHead must produce finite grads for
+    both head params and the input."""
+    from dinov3_tpu.ops.dino_head import DINOHead
+
+    head = DINOHead(out_dim=16, hidden_dim=8, bottleneck_dim=4, nlayers=3,
+                    dtype=jnp.float32)
+    x = jnp.zeros((2, 8), jnp.float32)
+    params = head.init(jax.random.key(0), x)
+
+    def loss(p, x):
+        return jnp.sum(jax.nn.log_softmax(head.apply(p, x)) ** 2)
+
+    gp, gx = jax.grad(loss, argnums=(0, 1))(params, x)
+    assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(gp))
+    assert bool(jnp.isfinite(gx).all())
+
+
+def test_koleo_zero_rows_gradient_finite():
+    x = jnp.zeros((8, 16))
+    g = jax.grad(lambda v: koleo_loss(v))(x)
+    assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))

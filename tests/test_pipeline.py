@@ -1,0 +1,156 @@
+"""Pipeline parallelism (parallel/pipeline.py) on the 8-device CPU mesh.
+
+The reference has no PP (SURVEY.md §2.5 "PP — absent"); these tests pin the
+TPU-native addition: a GPipe schedule over stage-stacked block params must
+be numerically identical to running the same blocks sequentially, and the
+full SSL train step must run under a (data, pipe, fsdp) mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+from dinov3_tpu.data import make_synthetic_batch
+from dinov3_tpu.models import build_backbone
+from dinov3_tpu.parallel import build_mesh, set_current_mesh
+from dinov3_tpu.parallel.mesh import MeshSpec
+from dinov3_tpu.train import build_train_setup, put_batch
+
+SMOL = [
+    "student.arch=vit_test", "student.patch_size=4",
+    "student.drop_path_rate=0.0", "student.layerscale=1.0e-5",
+    "crops.global_crops_size=16", "crops.local_crops_size=8",
+    "crops.local_crops_number=2",
+    "dino.head_n_prototypes=32", "dino.head_hidden_dim=24",
+    "dino.head_bottleneck_dim=8",
+    "ibot.head_n_prototypes=32", "ibot.head_hidden_dim=24",
+    "ibot.head_bottleneck_dim=8",
+    "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=4",
+    "optim.warmup_epochs=1", "optim.freeze_last_layer_epochs=1",
+    "compute_precision.compute_dtype=fp32",
+    "optim.scaling_rule=none",
+]
+
+
+def _cfg(extra=()):
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, list(SMOL) + list(extra))
+    return cfg
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    yield
+    set_current_mesh(None)
+
+
+def test_pipelined_forward_matches_sequential(eight_devices):
+    """Same init seed => pipelined forward == plain per-block forward.
+
+    vit_test has 2 blocks; run 2 stages x 2 microbatches on a pipe=2 mesh.
+    The stacked [S, L/S, ...] params are reshaped from the sequential
+    blocks' params so both models compute with identical weights.
+    """
+    mesh = build_mesh(MeshSpec(data=2, pipe=2, fsdp=2), devices=eight_devices)
+    set_current_mesh(mesh)
+
+    cfg = _cfg()
+    seq_model = build_backbone(cfg, teacher=True)
+    apply_dot_overrides(cfg, ["parallel.pipe=2"])
+    pipe_model = build_backbone(cfg, teacher=True)
+    assert pipe_model.pipeline_stages == 2
+
+    import flax.linen as nn
+
+    x = jax.random.normal(jax.random.key(1), (4, 16, 16, 3), jnp.float32)
+    seq_params = nn.meta.unbox(seq_model.init(jax.random.key(0), x))["params"]
+    pipe_params = nn.meta.unbox(pipe_model.init(jax.random.key(0), x))["params"]
+
+    # graft the sequential blocks' weights into the stage-stacked layout:
+    # blocks_{i} -> stage axis s = i // (L/S), within-stage scan axis i % (L/S)
+    from flax.core import unfreeze
+
+    pipe_params = unfreeze(pipe_params)
+    grafted = jax.tree.map(
+        lambda a, b: jnp.stack([a[None], b[None]]),  # [S=2, L/S=1, ...]
+        seq_params["blocks_0"], seq_params["blocks_1"],
+    )
+    target = pipe_params["pipeline"]["tick"]["stages"]["blocks"]["block"]
+    same = jax.tree.map(lambda a, b: a.shape == b.shape, grafted, target)
+    assert all(jax.tree.leaves(same))
+    pipe_params["pipeline"]["tick"]["stages"]["blocks"]["block"] = grafted
+    for k, v in seq_params.items():
+        if not k.startswith("blocks_"):
+            pipe_params[k] = v
+
+    out_seq = seq_model.apply({"params": seq_params}, x)
+    with mesh:
+        out_pipe = jax.jit(
+            lambda p, x: pipe_model.apply({"params": p}, x)
+        )(pipe_params, x)
+    np.testing.assert_allclose(
+        np.asarray(out_seq["x_norm_clstoken"], np.float32),
+        np.asarray(out_pipe["x_norm_clstoken"], np.float32),
+        rtol=2e-5, atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_seq["x_norm_patchtokens"], np.float32),
+        np.asarray(out_pipe["x_norm_patchtokens"], np.float32),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_microbatch_counts(eight_devices):
+    """M > S and M == B paths produce the same result."""
+    mesh = build_mesh(MeshSpec(data=2, pipe=2, fsdp=2), devices=eight_devices)
+    set_current_mesh(mesh)
+    cfg = _cfg(["parallel.pipe=2"])
+    x = jax.random.normal(jax.random.key(1), (4, 16, 16, 3), jnp.float32)
+
+    outs = []
+    for m in (2, 4):
+        apply_dot_overrides(cfg, [f"parallel.pipe_microbatches={m}"])
+        model = build_backbone(cfg, teacher=True)
+        import flax.linen as nn
+
+        params = nn.meta.unbox(model.init(jax.random.key(0), x))
+        with mesh:
+            out = jax.jit(lambda p, x: model.apply(p, x))(params, x)
+        outs.append(np.asarray(out["x_norm_clstoken"], np.float32))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-5, atol=2e-5)
+
+
+def test_pipelined_train_step(eight_devices):
+    """Full fused SSL step under (data=2, pipe=2, fsdp=2): finite loss over
+    two steps (donation path) and stage-stacked params sharded over pipe."""
+    cfg = _cfg(["parallel.data=2", "parallel.pipe=2", "parallel.fsdp=2"])
+    B = 8
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, B, seed=0).items()}
+    setup = build_train_setup(cfg, batch, devices=eight_devices)
+    assert setup.mesh.shape["pipe"] == 2
+
+    # the stage axis of stacked block params must be sharded over pipe
+    blk_sh = setup.state_shardings.params["student"]["backbone"]["pipeline"]
+    def has_pipe(s):
+        return any(
+            "pipe" in (ax if isinstance(ax, tuple) else (ax,))
+            for ax in s.spec if ax is not None
+        )
+    assert all(has_pipe(s) for s in jax.tree.leaves(blk_sh)), blk_sh
+    blk = setup.state.params["student"]["backbone"]["pipeline"]["tick"]["stages"]
+    leaf = jax.tree.leaves(blk)[0]
+    assert leaf.shape[0] == 2  # n_stages leading axis
+
+    dbatch = put_batch(batch, setup.batch_shardings)
+    state, metrics = setup.step_fn(
+        setup.state, dbatch, setup.scalars(0), jax.random.key(0)
+    )
+    assert np.isfinite(float(metrics["total_loss"]))
+    assert int(state.step) == 1
+    state, metrics2 = setup.step_fn(
+        state, dbatch, setup.scalars(1), jax.random.key(0)
+    )
+    assert np.isfinite(float(metrics2["total_loss"]))
