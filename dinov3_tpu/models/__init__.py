@@ -67,6 +67,25 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
     kw["pipeline_stages"] = int(parallel.get("pipe", 1) or 1)
     kw["pipeline_microbatches"] = int(parallel.get("pipe_microbatches", 0) or 0)
     kw["scan_layers"] = bool(train.get("scan_layers", False))
+    # fp8 projections inside blocks when the filter regex matches "blocks"
+    # (reference config surface: student.fp8_enabled / fp8_filter,
+    # ssl_default_config.yaml:121-122). Student only: the EMA teacher's
+    # distillation targets stay full precision, like the other
+    # student-only training knobs above (drop path, rope augmentation).
+    if bool(s.get("fp8_enabled", False)) and not teacher:
+        import re
+
+        filt = str(s.get("fp8_filter", "blocks") or "")
+        kw["fp8"] = bool(re.search(filt, "blocks")) if filt else True
+        if not kw["fp8"]:
+            import logging
+
+            logging.getLogger("dinov3").warning(
+                "student.fp8_enabled=true but fp8_filter=%r does not match "
+                "'blocks' (the supported granularity is the whole block "
+                "stack) — fp8 is OFF", filt,
+            )
+
     policy = Policy.from_cfg(cfg.compute_precision)
     kw["dtype"] = policy.compute_dtype
     kw["param_dtype"] = policy.param_dtype

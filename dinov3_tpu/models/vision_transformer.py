@@ -72,6 +72,7 @@ class DinoVisionTransformer(nn.Module):
     scan_layers: bool = False
     pipeline_stages: int = 1       # >1: GPipe pipeline over the pipe axis
     pipeline_microbatches: int = 0  # 0 = pipeline_stages
+    fp8: bool = False              # fp8 projections inside blocks
     remat: str = "none"  # none | blocks | full
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -160,7 +161,7 @@ class DinoVisionTransformer(nn.Module):
             drop_path_rate=self.drop_path_rate,
             layerscale_init=self.layerscale_init,
             mask_k_bias=self.mask_k_bias, attn_impl=self.attn_impl,
-            seq_parallel=self.seq_parallel,
+            seq_parallel=self.seq_parallel, fp8=self.fp8,
             dtype=self.dtype, param_dtype=self.param_dtype,
             reduce_dtype=self.reduce_dtype,
         )

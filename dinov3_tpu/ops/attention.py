@@ -23,7 +23,12 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from dinov3_tpu.ops.common import constrain, part, trunc_normal_init
+from dinov3_tpu.ops.common import (
+    constrain,
+    fp8_matmul,
+    part,
+    trunc_normal_init,
+)
 from dinov3_tpu.ops.rope import rope_apply_full, rope_apply_with_prefix
 
 
@@ -93,6 +98,7 @@ class SelfAttention(nn.Module):
     mask_k_bias: bool = False
     attn_impl: str = "auto"
     seq_parallel: bool = False
+    fp8: bool = False  # current-scaling fp8 projections (ops/common.py)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -111,7 +117,8 @@ class SelfAttention(nn.Module):
             "qkv_kernel", part(trunc_normal_init(), ("embed", "heads")),
             (self.dim, 3 * self.dim), self.param_dtype,
         )
-        qkv = x.astype(self.dtype) @ qkv_kernel.astype(self.dtype)
+        mm = fp8_matmul if self.fp8 else (lambda a, b: a @ b)
+        qkv = mm(x.astype(self.dtype), qkv_kernel.astype(self.dtype))
         if self.qkv_bias:
             qkv_b = self.param(
                 "qkv_bias", part(nn.initializers.zeros, ("heads",)),
@@ -159,7 +166,7 @@ class SelfAttention(nn.Module):
             "proj_kernel", part(trunc_normal_init(), ("heads", "embed")),
             (self.dim, self.dim), self.param_dtype,
         )
-        y = out.astype(self.dtype) @ proj_kernel.astype(self.dtype)
+        y = mm(out.astype(self.dtype), proj_kernel.astype(self.dtype))
         if self.proj_bias:
             proj_b = self.param(
                 "proj_bias", part(nn.initializers.zeros, ("embed",)),

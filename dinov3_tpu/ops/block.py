@@ -33,6 +33,7 @@ class SelfAttentionBlock(nn.Module):
     mask_k_bias: bool = False
     attn_impl: str = "auto"
     seq_parallel: bool = False
+    fp8: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -56,7 +57,7 @@ class SelfAttentionBlock(nn.Module):
             dim=self.dim, num_heads=self.num_heads, qkv_bias=self.qkv_bias,
             proj_bias=self.proj_bias, mask_k_bias=self.mask_k_bias,
             attn_impl=self.attn_impl, seq_parallel=self.seq_parallel,
-            dtype=self.dtype,
+            fp8=self.fp8, dtype=self.dtype,
             param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
             name="attn",
         )(make_norm_layer(self.norm_layer, name="norm1", **norm_kw)(x),
@@ -65,7 +66,7 @@ class SelfAttentionBlock(nn.Module):
 
         ffn_out = make_ffn_layer(
             self.ffn_layer, int(self.dim * self.ffn_ratio),
-            use_bias=self.ffn_bias, dtype=self.dtype,
+            use_bias=self.ffn_bias, fp8=self.fp8, dtype=self.dtype,
             param_dtype=self.param_dtype, name="mlp",
         )(make_norm_layer(self.norm_layer, name="norm2", **norm_kw)(x),
           deterministic=deterministic)

@@ -84,6 +84,52 @@ def part(init: Callable, names: Sequence[str | None]) -> Callable:
     return nn.with_logical_partitioning(init, tuple(names))
 
 
+# ---------------- fp8 matmul path ----------------
+#
+# (reference config surface: ssl_default_config.yaml:121-122
+# ``student.fp8_enabled`` / ``student.fp8_filter`` — "Convert Linear layers
+# to operate in fp8 precision". The reference never implemented it; here it
+# is a current-scaling fp8 forward: per-tensor amax scales both operands
+# into the float8_e4m3 range, the dot runs in f8 with fp32 accumulation,
+# and the product of scales is applied to the output. Scales carry
+# stop_gradient (straight-through), so the backward pass is the usual
+# bf16/fp32 path. On fp8-capable TPUs XLA lowers the f8 dot natively; on
+# older MXUs it upconverts — a capability knob, not a universal speedup.)
+
+_F8_MAX = 448.0  # float8_e4m3 finite max
+
+
+def fp8_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                    preferred_element_type=None):
+    """Drop-in ``dot_general`` that quantizes both operands to f8e4m3."""
+    import jax
+
+    f8 = jnp.float8_e4m3fn
+    out_dtype = preferred_element_type or lhs.dtype
+
+    def quantize(t):
+        tf = t.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(tf))
+        scale = jax.lax.stop_gradient(jnp.maximum(amax, 1e-12) / _F8_MAX)
+        return (tf / scale).astype(f8), scale
+
+    ql, sl = quantize(lhs)
+    qr, sr = quantize(rhs)
+    out = jax.lax.dot_general(
+        ql, qr, dimension_numbers, preferred_element_type=jnp.float32
+    )
+    return (out * (sl * sr)).astype(out_dtype)
+
+
+def fp8_matmul(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """``x @ w`` through the fp8 path (last dim of x contracts with dim 0
+    of w)."""
+    return fp8_dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=x.dtype,
+    )
+
+
 def constrain(x: jnp.ndarray, names: Sequence[str | None]) -> jnp.ndarray:
     """Logical sharding constraint on an activation (no-op outside a mesh)."""
     return nn.with_logical_constraint(x, tuple(names))

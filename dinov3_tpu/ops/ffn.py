@@ -14,7 +14,11 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax.numpy as jnp
 
-from dinov3_tpu.ops.common import part, trunc_normal_init
+from dinov3_tpu.ops.common import fp8_dot_general, part, trunc_normal_init
+
+
+def _dense_kwargs(fp8: bool) -> dict:
+    return {"dot_general": fp8_dot_general} if fp8 else {}
 
 
 class Mlp(nn.Module):
@@ -23,6 +27,7 @@ class Mlp(nn.Module):
     act: Callable = nn.gelu
     use_bias: bool = True
     dropout_rate: float = 0.0
+    fp8: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -34,7 +39,7 @@ class Mlp(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=part(trunc_normal_init(), ("embed", "mlp")),
             bias_init=part(nn.initializers.zeros, ("mlp",)),
-            name="fc1",
+            name="fc1", **_dense_kwargs(self.fp8),
         )(x)
         x = self.act(x)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
@@ -43,7 +48,7 @@ class Mlp(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=part(trunc_normal_init(), ("mlp", "embed")),
             bias_init=part(nn.initializers.zeros, ("embed",)),
-            name="fc2",
+            name="fc2", **_dense_kwargs(self.fp8),
         )(x)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         return x
@@ -60,6 +65,7 @@ class SwiGLUFFN(nn.Module):
     out_dim: int | None = None
     use_bias: bool = True
     align_to: int = 64  # keep the hidden dim MXU/lane aligned on TPU
+    fp8: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -73,7 +79,7 @@ class SwiGLUFFN(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=part(trunc_normal_init(), ("embed", "mlp")),
             bias_init=part(nn.initializers.zeros, ("mlp",)),
-            name="w12",
+            name="w12", **_dense_kwargs(self.fp8),
         )(x)
         gate, value = jnp.split(w12, 2, axis=-1)
         x = nn.silu(gate) * value
@@ -82,7 +88,7 @@ class SwiGLUFFN(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=part(trunc_normal_init(), ("mlp", "embed")),
             bias_init=part(nn.initializers.zeros, ("embed",)),
-            name="w3",
+            name="w3", **_dense_kwargs(self.fp8),
         )(x)
 
 

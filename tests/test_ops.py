@@ -244,3 +244,60 @@ def test_block_grads_flow():
     leaves = jax.tree.leaves(g)
     assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
     assert any(np.abs(np.asarray(l)).max() > 0 for l in leaves)
+
+
+# ---------------- fp8 matmul path ----------------
+
+def test_fp8_dot_close_to_dense():
+    """Current-scaling fp8 matmul approximates the bf16/fp32 product within
+    e4m3 quantization error, and its gradients are finite."""
+    from dinov3_tpu.ops.common import fp8_matmul
+
+    k = jax.random.key(0)
+    x = jax.random.normal(k, (32, 64), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(k, 1), (64, 48), jnp.float32) * 0.05
+    ref = x @ w
+    out = fp8_matmul(x, w)
+    err = float(jnp.abs(out - ref).max() / (jnp.abs(ref).max() + 1e-9))
+    assert err < 0.08, err  # e4m3 has ~2 decimal digits
+
+    g = jax.grad(lambda w: jnp.sum(fp8_matmul(x, w) ** 2))(w)
+    assert bool(jnp.isfinite(g).all())
+
+
+def test_fp8_block_forward_and_grads():
+    """A transformer block with fp8 projections stays close to the exact
+    block and yields finite grads (reference config surface:
+    student.fp8_enabled, ssl_default_config.yaml:121-122)."""
+    from dinov3_tpu.ops.block import SelfAttentionBlock
+
+    kw = dict(dim=64, num_heads=2, ffn_ratio=2.0, drop_path_rate=0.0,
+              layerscale_init=1e-5, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 10, 64), jnp.float32)
+    exact = SelfAttentionBlock(**kw)
+    quant = SelfAttentionBlock(fp8=True, **kw)
+    params = exact.init(jax.random.key(1), x)
+    y_exact = exact.apply(params, x)
+    y_quant = quant.apply(params, x)  # same param structure
+    rel = float(jnp.abs(y_quant - y_exact).max() /
+                (jnp.abs(y_exact).max() + 1e-9))
+    assert rel < 0.05, rel
+
+    g = jax.grad(
+        lambda p: jnp.sum(quant.apply(p, x) ** 2)
+    )(params)
+    assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))
+
+
+def test_fp8_flag_threads_from_config():
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.models import backbone_kwargs_from_cfg
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, ["student.arch=vit_test",
+                              "student.fp8_enabled=true"])
+    kw = backbone_kwargs_from_cfg(cfg)
+    assert kw.get("fp8") is True
+    apply_dot_overrides(cfg, ["student.fp8_filter=nothing_matches"])
+    kw = backbone_kwargs_from_cfg(cfg)
+    assert not kw.get("fp8")
