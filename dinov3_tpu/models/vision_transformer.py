@@ -27,7 +27,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from dinov3_tpu.ops.block import ScanBlockAdapter, SelfAttentionBlock
+from dinov3_tpu.ops.block import (
+    ScanBlockAdapter,
+    SelfAttentionBlock,
+    remat_block_cls,
+)
 from dinov3_tpu.ops.common import canonical_dtype, part
 from dinov3_tpu.ops.norms import make_norm_layer
 from dinov3_tpu.ops.patch_embed import PatchEmbed
@@ -36,6 +40,29 @@ from dinov3_tpu.ops.rope import (
     rope_sincos,
     rope_with_identity_prefix,
 )
+
+
+class _CollectScanBlock(nn.Module):
+    """Scan adapter that also fills a [K, B, N, D] buffer with the outputs
+    of the requested layers (carry = (x, buffer); ``i`` is the layer index
+    scanned over). Only K requested layers are kept — stacking all L
+    outputs as scan ys would cost L/K more activation memory at eval time.
+    Param path matches ScanBlockAdapter ("blocks"/"block"), so the same
+    trained params serve both applies."""
+
+    block_kwargs: dict
+    collect_idx: tuple  # static, sorted
+    remat: str = "none"
+
+    @nn.compact
+    def __call__(self, carry, i, rope, deterministic: bool):
+        x, buf = carry
+        x = remat_block_cls(self.remat)(
+            **self.block_kwargs, name="block"
+        )(x, rope, deterministic)
+        hit = (jnp.asarray(self.collect_idx) == i)[:, None, None, None]
+        buf = jnp.where(hit, x[None].astype(buf.dtype), buf)
+        return (x, buf), None
 
 
 class DinoVisionTransformer(nn.Module):
@@ -190,6 +217,22 @@ class DinoVisionTransformer(nn.Module):
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(block_kwargs=self._block_kwargs(), remat=self.remat, name="blocks")
             x, _ = scanned(x, rope, deterministic)
+        elif self.scan_layers:
+            take = tuple(sorted(collect))
+            scanned = nn.scan(
+                _CollectScanBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "drop_path": True, "dropout": True},
+                in_axes=(0, nn.broadcast, nn.broadcast),
+                length=self.n_blocks,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block_kwargs=self._block_kwargs(), collect_idx=take,
+              remat=self.remat, name="blocks")
+            buf0 = jnp.zeros((len(take),) + x.shape, x.dtype)
+            (x, buf), _ = scanned(
+                (x, buf0), jnp.arange(self.n_blocks), rope, deterministic
+            )
+            collected = {i: buf[k] for k, i in enumerate(take)}
         else:
             for i in range(self.n_blocks):
                 block_cls = SelfAttentionBlock
@@ -295,10 +338,9 @@ class DinoVisionTransformer(nn.Module):
     ):
         """Eval-time feature extraction (reference:280-312, with its reshape
         and index typos fixed)."""
-        if self.scan_layers or self.pipeline_stages > 1:
+        if self.pipeline_stages > 1:
             raise NotImplementedError(
-                "get_intermediate_layers requires scan_layers=False and "
-                "pipeline_stages=1"
+                "get_intermediate_layers requires pipeline_stages=1"
             )
         tokens, (h, w) = self._prepare_tokens(x, None)
         rope = self._rope_table(h, w, True)
@@ -313,9 +355,12 @@ class DinoVisionTransformer(nn.Module):
             normed = []
             norm_kw = dict(param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype)
             norm_l = make_norm_layer(self.norm_layer, name="norm", **norm_kw)
+            cls_l = (
+                make_norm_layer(self.norm_layer, name="cls_norm", **norm_kw)
+                if self.untie_cls_and_patch_norms else None
+            )
             for out in outputs:
-                if self.untie_cls_and_patch_norms:
-                    cls_l = make_norm_layer(self.norm_layer, name="cls_norm", **norm_kw)
+                if cls_l is not None:
                     normed.append(jnp.concatenate(
                         [cls_l(out[:, :n_prefix]), norm_l(out[:, n_prefix:])], axis=1
                     ))

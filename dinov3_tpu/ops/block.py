@@ -73,6 +73,20 @@ class SelfAttentionBlock(nn.Module):
         x = x + dp(ls("ls2")(ffn_out), deterministic=deterministic)
         return x
 
+def remat_block_cls(remat: str):
+    """SelfAttentionBlock, optionally wrapped for rematerialization."""
+    import jax
+
+    if remat in ("blocks", "full"):
+        return nn.remat(
+            SelfAttentionBlock,
+            static_argnums=(3,),
+            policy=(None if remat == "full"
+                    else jax.checkpoint_policies.dots_with_no_batch_dims_saveable),
+        )
+    return SelfAttentionBlock
+
+
 class ScanBlockAdapter(nn.Module):
     """(carry, ys) scan contract for SelfAttentionBlock, shared by the
     scan-over-blocks model path (models/vision_transformer.py) and the
@@ -83,15 +97,7 @@ class ScanBlockAdapter(nn.Module):
 
     @nn.compact
     def __call__(self, x, rope, deterministic: bool):
-        import jax
-
-        block_cls = SelfAttentionBlock
-        if self.remat in ("blocks", "full"):
-            block_cls = nn.remat(
-                block_cls,
-                static_argnums=(3,),
-                policy=(None if self.remat == "full"
-                        else jax.checkpoint_policies.dots_with_no_batch_dims_saveable),
-            )
-        x = block_cls(**self.block_kwargs, name="block")(x, rope, deterministic)
+        x = remat_block_cls(self.remat)(
+            **self.block_kwargs, name="block"
+        )(x, rope, deterministic)
         return x, None

@@ -65,6 +65,10 @@ def get_args_parser():
                    help="run two diagnostic steps on one batch (losses "
                         "finite, every submodule trains, teacher EMA "
                         "tracks) and exit")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans: the first op producing a "
+                        "NaN raises with its location (slower; de-fuses "
+                        "the step for op-level blame)")
     p.add_argument("opts", nargs="*", default=[],
                    help="key.path=value config overrides")
     return p
@@ -293,6 +297,12 @@ def do_train(cfg, args) -> dict:
 
 def main(argv=None):
     args = get_args_parser().parse_args(argv)
+    if args.debug_nans:
+        # SURVEY.md §5.2: the reference had no sanitizer story beyond
+        # check_vma=False escapes; this is the TPU-native one — XLA re-runs
+        # the step op-by-op on the first non-finite value and raises at
+        # the producing op.
+        jax.config.update("jax_debug_nans", True)
     initialize_distributed()
     cfg = load_config(args.config_file or None, overrides=list(args.opts))
     cfg.train.output_dir = args.output_dir

@@ -148,3 +148,46 @@ def test_arch_ladder_dims():
     assert (b7.embed_dim, b7.n_blocks, b7.num_heads, b7.ffn_ratio) == (4096, 40, 32, 3.0)
     so = vit_so400m()
     assert (so.embed_dim, so.n_blocks, so.num_heads) == (1152, 27, 18)
+
+
+def test_get_intermediate_layers_scan():
+    """Scan-over-blocks models support intermediate-feature extraction via
+    scan ys; results match the unrolled loop given transplanted params."""
+    import flax
+
+    m_loop = tiny(n_blocks=3)
+    m_scan = tiny(n_blocks=3, scan_layers=True)
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 3))
+    p_loop = nn.meta.unbox(m_loop.init(jax.random.key(1), x))
+    flat_loop = flax.traverse_util.flatten_dict(p_loop["params"])
+    p_scan = nn.meta.unbox(m_scan.init(jax.random.key(1), x))
+    flat_scan = flax.traverse_util.flatten_dict(p_scan["params"])
+    stacked = {}
+    for k, v in flat_scan.items():
+        if k[0] == "blocks":
+            stacked[k] = jnp.stack(
+                [flat_loop[(f"blocks_{i}",) + k[2:]] for i in range(3)], axis=0
+            )
+        else:
+            stacked[k] = flat_loop[k]
+    p_scan2 = {"params": flax.traverse_util.unflatten_dict(stacked)}
+
+    kw = dict(n=2, return_class_token=True,
+              method=DinoVisionTransformer.get_intermediate_layers)
+    outs_loop = m_loop.apply(p_loop, x, **kw)
+    outs_scan = m_scan.apply(p_scan2, x, **kw)
+    assert len(outs_scan) == len(outs_loop) == 2
+    for (pl, cl), (ps, cs) in zip(outs_loop, outs_scan):
+        np.testing.assert_allclose(np.asarray(pl), np.asarray(ps), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cl), np.asarray(cs), atol=1e-5)
+
+
+def test_get_intermediate_layers_untied_norms_multi():
+    """n>1 with untied cls/patch norms (large-model recipes) must not
+    raise a flax name collision."""
+    m = tiny(n_blocks=3, untie_cls_and_patch_norms=True)
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 3))
+    params = m.init(jax.random.key(1), x)
+    outs = m.apply(params, x, n=2,
+                   method=DinoVisionTransformer.get_intermediate_layers)
+    assert len(outs) == 2
