@@ -60,6 +60,25 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
     kw["remat"] = {False: "none", True: "blocks"}.get(train.get("checkpointing", False), "none")
     if train.get("checkpointing_full", False):
         kw["remat"] = "full"
+    # parallel.remat: non-none values override the train.checkpointing
+    # mapping (the merged config cannot distinguish an explicit "none"
+    # from the schema default)
+    pr = str((cfg.get("parallel") or {}).get("remat", "none") or "none")
+    if pr not in ("none", "attn", "blocks", "full"):
+        raise ValueError(
+            f"parallel.remat={pr!r}: expected none|attn|blocks|full"
+        )
+    if pr != "none":
+        kw["remat"] = pr
+    if kw["remat"] == "attn" and kw["seq_parallel"]:
+        import logging
+
+        logging.getLogger("dinov3").warning(
+            "remat=attn has no effect under seq parallelism: ring "
+            "attention never materializes the [N, N] softmax state "
+            "(same for the pallas flash kernel at >=%d tokens)",
+            1024,
+        )
     kernels = cfg.get("kernels") or {}
     kw["attn_impl"] = kernels.get("flash_attention", "auto")
     parallel = cfg.get("parallel") or {}

@@ -235,17 +235,9 @@ class DinoVisionTransformer(nn.Module):
             collected = {i: buf[k] for k, i in enumerate(take)}
         else:
             for i in range(self.n_blocks):
-                block_cls = SelfAttentionBlock
-                if self.remat in ("blocks", "full"):
-                    block_cls = nn.remat(
-                        block_cls,
-                        static_argnums=(3,),
-                        policy=(None if self.remat == "full"
-                                else jax.checkpoint_policies.dots_with_no_batch_dims_saveable),
-                    )
-                x = block_cls(**self._block_kwargs(), name=f"blocks_{i}")(
-                    x, rope, deterministic
-                )
+                x = remat_block_cls(self.remat)(
+                    **self._block_kwargs(), name=f"blocks_{i}"
+                )(x, rope, deterministic)
                 if i in collect:
                     collected[i] = x
         return x, collected
@@ -348,6 +340,12 @@ class DinoVisionTransformer(nn.Module):
             list(range(self.n_blocks - n, self.n_blocks))
             if isinstance(n, int) else list(n)
         )
+        bad = [i for i in take if not 0 <= i < self.n_blocks]
+        if bad:
+            raise ValueError(
+                f"layer indices {bad} out of range for {self.n_blocks} "
+                "blocks"
+            )
         _, collected = self._run_blocks(tokens, rope, True, collect=take)
         outputs = [collected[i] for i in take]
         n_prefix = 1 + self.n_storage_tokens

@@ -45,6 +45,12 @@ def xla_attention(
                         preferred_element_type=reduce_dtype)
     logits = (logits * scale).astype(reduce_dtype)
     probs = jax.nn.softmax(logits, axis=-1)
+    # named for the "attn" remat policy (ops/block.py remat_block_cls):
+    # the [B, h, N, N] fp32 softmax state dominates saved activations at
+    # long N; recomputing it in the backward trades cheap FLOPs for HBM
+    from jax.ad_checkpoint import checkpoint_name
+
+    probs = checkpoint_name(probs, "attn_probs")
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 

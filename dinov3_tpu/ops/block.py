@@ -74,9 +74,29 @@ class SelfAttentionBlock(nn.Module):
         return x
 
 def remat_block_cls(remat: str):
-    """SelfAttentionBlock, optionally wrapped for rematerialization."""
+    """SelfAttentionBlock, optionally wrapped for rematerialization.
+
+    Modes: "none"; "attn" (save everything except the named fp32 softmax
+    state — recomputed in backward, big HBM saving at long N); "blocks"
+    (save only weight matmuls); "full" (save nothing).
+
+    "attn" only has an effect on the dense XLA attention path — the pallas
+    flash kernel and ring attention never materialize the [N, N] probs in
+    the first place (models/__init__.py warns on that combination)."""
     import jax
 
+    if remat not in ("none", "attn", "blocks", "full"):
+        raise ValueError(
+            f"unknown remat mode {remat!r}; expected none|attn|blocks|full"
+        )
+    if remat == "attn":
+        return nn.remat(
+            SelfAttentionBlock,
+            static_argnums=(3,),
+            policy=jax.checkpoint_policies.save_anything_except_these_names(
+                "attn_probs"
+            ),
+        )
     if remat in ("blocks", "full"):
         return nn.remat(
             SelfAttentionBlock,

@@ -301,3 +301,27 @@ def test_fp8_flag_threads_from_config():
     apply_dot_overrides(cfg, ["student.fp8_filter=nothing_matches"])
     kw = backbone_kwargs_from_cfg(cfg)
     assert not kw.get("fp8")
+
+
+def test_remat_attn_matches_none():
+    """remat='attn' (recompute softmax state in backward) must be exact —
+    same outputs and same grads as no remat."""
+    from dinov3_tpu.ops.block import SelfAttentionBlock, remat_block_cls
+
+    kw = dict(dim=32, num_heads=2, ffn_ratio=2.0, drop_path_rate=0.0,
+              layerscale_init=1e-5, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 9, 32), jnp.float32)
+    base = SelfAttentionBlock(**kw)
+    params = base.init(jax.random.key(1), x)
+
+    def loss(cls_fn, p):
+        return jnp.sum(cls_fn(**kw).apply(p, x, None, True) ** 2)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(SelfAttentionBlock, p))(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: loss(remat_block_cls("attn"), p)
+    )(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6)

@@ -191,3 +191,14 @@ def test_get_intermediate_layers_untied_norms_multi():
     outs = m.apply(params, x, n=2,
                    method=DinoVisionTransformer.get_intermediate_layers)
     assert len(outs) == 2
+
+
+def test_get_intermediate_layers_rejects_bad_indices():
+    m = tiny(n_blocks=3, scan_layers=True)
+    x = jax.random.normal(jax.random.key(0), (1, 8, 8, 3))
+    params = m.init(jax.random.key(1), x)
+    import pytest
+
+    with pytest.raises(ValueError, match="out of range"):
+        m.apply(params, x, n=[3],
+                method=DinoVisionTransformer.get_intermediate_layers)
