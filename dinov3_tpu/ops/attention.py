@@ -37,6 +37,7 @@ def xla_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     reduce_dtype=jnp.float32,
+    causal: bool = False,
 ) -> jnp.ndarray:
     """Unfused attention: [B, N, h, d] inputs, softmax in reduce_dtype."""
     d = q.shape[-1]
@@ -44,6 +45,11 @@ def xla_attention(
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=reduce_dtype)
     logits = (logits * scale).astype(reduce_dtype)
+    if causal:
+        N = q.shape[1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, N, N), 2)
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, N, N), 3)
+        logits = jnp.where(col <= row, logits, jnp.asarray(-jnp.inf, logits.dtype))
     probs = jax.nn.softmax(logits, axis=-1)
     # named for the "attn" remat policy (ops/block.py remat_block_cls):
     # the [B, h, N, N] fp32 softmax state dominates saved activations at
@@ -105,6 +111,7 @@ class SelfAttention(nn.Module):
     attn_impl: str = "auto"
     seq_parallel: bool = False
     fp8: bool = False  # current-scaling fp8 projections (ops/common.py)
+    causal: bool = False  # triangular mask (dense XLA path only)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -155,7 +162,11 @@ class SelfAttention(nn.Module):
                 )
 
         out = None
-        if self.seq_parallel:
+        if self.causal:
+            # causal runs the dense path (ViT's SSL path never uses it;
+            # reference kept a CausalSelfAttention for generative probes)
+            out = xla_attention(q, k, v, self.reduce_dtype, causal=True)
+        if out is None and self.seq_parallel:
             from dinov3_tpu.parallel.context import get_current_mesh
 
             mesh = get_current_mesh()
@@ -182,3 +193,11 @@ class SelfAttention(nn.Module):
         if self.proj_drop > 0.0:
             y = nn.Dropout(self.proj_drop)(y, deterministic=deterministic)
         return y
+
+
+class CausalSelfAttention(SelfAttention):
+    """Causally-masked variant (reference: dinov3_jax/layers/attention.py
+    CausalSelfAttention:135 — present in the reference inventory but unused
+    by the ViT SSL path; kept for generative/probing heads)."""
+
+    causal: bool = True

@@ -34,6 +34,7 @@ class SelfAttentionBlock(nn.Module):
     attn_impl: str = "auto"
     seq_parallel: bool = False
     fp8: bool = False
+    causal: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -57,7 +58,7 @@ class SelfAttentionBlock(nn.Module):
             dim=self.dim, num_heads=self.num_heads, qkv_bias=self.qkv_bias,
             proj_bias=self.proj_bias, mask_k_bias=self.mask_k_bias,
             attn_impl=self.attn_impl, seq_parallel=self.seq_parallel,
-            fp8=self.fp8, dtype=self.dtype,
+            fp8=self.fp8, causal=self.causal, dtype=self.dtype,
             param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
             name="attn",
         )(make_norm_layer(self.norm_layer, name="norm1", **norm_kw)(x),
@@ -121,3 +122,11 @@ class ScanBlockAdapter(nn.Module):
             **self.block_kwargs, name="block"
         )(x, rope, deterministic)
         return x, None
+
+
+class CausalSelfAttentionBlock(SelfAttentionBlock):
+    """Pre-norm block with causal attention (reference:
+    dinov3_jax/layers/block.py CausalSelfAttentionBlock — unused by the ViT
+    path, kept for parity)."""
+
+    causal: bool = True
