@@ -141,14 +141,14 @@ def load_config(
 def data_parallel_world(cfg: ConfigNode) -> int:
     """Number of devices holding independent batch shards.
 
-    Model-parallel axes (tensor, seq, pipe) replicate the batch, so they
-    are divided out of the device count.
+    Model-parallel axes (tensor, seq, pipe, expert) replicate the batch,
+    so they are divided out of the device count.
     """
     import jax
 
     replicas = 1
     par = cfg.get("parallel") or {}
-    for axis in ("tensor", "seq", "pipe"):
+    for axis in ("tensor", "seq", "pipe", "expert"):
         replicas *= int(par.get(axis, 1) or 1)
     return max(1, jax.device_count() // replicas)
 

@@ -35,6 +35,8 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
         drop_path_rate=0.0 if teacher else s.drop_path_rate,
         layerscale_init=s.layerscale,
         ffn_layer=s.ffn_layer,
+        moe_num_experts=int(s.get("moe_num_experts", 8) or 8),
+        moe_top_k=int(s.get("moe_top_k", 2) or 2),
         ffn_ratio=s.ffn_ratio,
         qkv_bias=s.qkv_bias,
         proj_bias=s.proj_bias,

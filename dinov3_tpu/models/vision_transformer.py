@@ -100,6 +100,8 @@ class DinoVisionTransformer(nn.Module):
     pipeline_stages: int = 1       # >1: GPipe pipeline over the pipe axis
     pipeline_microbatches: int = 0  # 0 = pipeline_stages
     fp8: bool = False              # fp8 projections inside blocks
+    moe_num_experts: int = 8       # only used when ffn_layer == "moe"
+    moe_top_k: int = 2
     remat: str = "none"  # none | blocks | full
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -189,6 +191,7 @@ class DinoVisionTransformer(nn.Module):
             layerscale_init=self.layerscale_init,
             mask_k_bias=self.mask_k_bias, attn_impl=self.attn_impl,
             seq_parallel=self.seq_parallel, fp8=self.fp8,
+            moe_num_experts=self.moe_num_experts, moe_top_k=self.moe_top_k,
             dtype=self.dtype, param_dtype=self.param_dtype,
             reduce_dtype=self.reduce_dtype,
         )
@@ -196,6 +199,13 @@ class DinoVisionTransformer(nn.Module):
     def _run_blocks(self, x, rope, deterministic, collect: Sequence[int] = ()):
         """Run the stack; optionally collect outputs of the listed layers."""
         collected = {}
+        if self.ffn_layer == "moe" and (
+            self.scan_layers or self.pipeline_stages > 1
+        ):
+            raise NotImplementedError(
+                "ffn_layer=moe requires the unrolled block path (its aux "
+                "loss is sown per block): set scan_layers=False, pipe=1"
+            )
         if self.pipeline_stages > 1 and not collect:
             from dinov3_tpu.parallel.pipeline import PipelinedBlocks
 

@@ -1,5 +1,10 @@
-from dinov3_tpu.ops.attention import SelfAttention, dispatch_attention, xla_attention
-from dinov3_tpu.ops.block import SelfAttentionBlock
+from dinov3_tpu.ops.attention import (
+    CausalSelfAttention,
+    SelfAttention,
+    dispatch_attention,
+    xla_attention,
+)
+from dinov3_tpu.ops.block import CausalSelfAttentionBlock, SelfAttentionBlock
 from dinov3_tpu.ops.common import Policy, canonical_dtype, constrain, part, trunc_normal_init
 from dinov3_tpu.ops.dino_head import DINOHead
 from dinov3_tpu.ops.drop_path import DropPath
@@ -17,8 +22,10 @@ from dinov3_tpu.ops.rope import (
 )
 
 __all__ = [
-    "SelfAttention", "dispatch_attention", "xla_attention",
-    "SelfAttentionBlock", "Policy", "canonical_dtype", "constrain", "part",
+    "SelfAttention", "CausalSelfAttention", "dispatch_attention",
+    "xla_attention",
+    "SelfAttentionBlock", "CausalSelfAttentionBlock",
+    "Policy", "canonical_dtype", "constrain", "part",
     "trunc_normal_init", "DINOHead", "DropPath", "Mlp", "SwiGLUFFN",
     "make_ffn_layer", "swiglu_hidden_dim", "LayerScale", "LayerNorm",
     "RMSNorm", "make_norm_layer", "PatchEmbed", "patch_coords", "rope_apply",

@@ -35,6 +35,8 @@ class SelfAttentionBlock(nn.Module):
     seq_parallel: bool = False
     fp8: bool = False
     causal: bool = False
+    moe_num_experts: int = 8   # only used when ffn_layer == "moe"
+    moe_top_k: int = 2
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -67,6 +69,7 @@ class SelfAttentionBlock(nn.Module):
 
         ffn_out = make_ffn_layer(
             self.ffn_layer, int(self.dim * self.ffn_ratio),
+            moe_num_experts=self.moe_num_experts, moe_top_k=self.moe_top_k,
             use_bias=self.ffn_bias, fp8=self.fp8, dtype=self.dtype,
             param_dtype=self.param_dtype, name="mlp",
         )(make_norm_layer(self.norm_layer, name="norm2", **norm_kw)(x),

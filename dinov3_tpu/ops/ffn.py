@@ -92,10 +92,105 @@ class SwiGLUFFN(nn.Module):
         )(x)
 
 
-def make_ffn_layer(kind: str, hidden_dim: int, **kwargs) -> nn.Module:
+class MoEFFN(nn.Module):
+    """Mixture-of-experts FFN with expert parallelism (beyond the
+    reference, which has no MoE — SURVEY.md §2.5 "EP — absent").
+
+    Dense (dropless) formulation: a linear router picks top-k experts per
+    token; every expert computes every token and outputs combine weighted
+    by the (renormalized) router probabilities, zero for non-selected
+    experts. FLOPs are ``num_experts`` times a dense MLP of the same
+    hidden size (``num_experts/top_k`` times a sparse top-k dispatch) —
+    the right trade below ~16 experts, where the alternative
+    (gather/scatter token dispatch) costs an all-to-all and ragged matmuls
+    that XLA cannot tile well. Expert params are stacked [E, ...] with the "experts" logical
+    axis -> ``expert`` mesh axis: each expert-parallel device computes its
+    own experts and XLA inserts one activation-sized all-reduce for the
+    combine.
+
+    An auxiliary load-balancing loss (Switch-style: E * sum_e f_e * p_e)
+    is stored in the "losses" collection under "moe_aux_loss".
+    """
+
+    hidden_dim: int
+    num_experts: int = 8
+    top_k: int = 2
+    out_dim: int | None = None
+    act: Callable = nn.gelu
+    use_bias: bool = True
+    fp8: bool = False  # accepted for make_ffn_layer symmetry; dense path only
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        import jax
+
+        D = x.shape[-1]
+        out_dim = self.out_dim or D
+        E, H, K = self.num_experts, self.hidden_dim, self.top_k
+        if not 1 <= K <= E:
+            raise ValueError(f"top_k={K} must be in [1, {E}]")
+
+        router = nn.Dense(
+            E, use_bias=False, dtype=jnp.float32,
+            param_dtype=self.param_dtype,
+            kernel_init=part(trunc_normal_init(), ("embed", None)),
+            name="router",
+        )
+        w1 = self.param(
+            "w1", part(trunc_normal_init(), ("experts", "embed", "mlp")),
+            (E, D, H), self.param_dtype,
+        )
+        w2 = self.param(
+            "w2", part(trunc_normal_init(), ("experts", "mlp", None)),
+            (E, H, out_dim), self.param_dtype,
+        )
+        b1 = b2 = None
+        if self.use_bias:
+            b1 = self.param("b1", part(nn.initializers.zeros, ("experts", "mlp")),
+                            (E, H), self.param_dtype)
+            b2 = self.param("b2", part(nn.initializers.zeros, ("experts", None)),
+                            (E, out_dim), self.param_dtype)
+
+        probs = jax.nn.softmax(router(x.astype(jnp.float32)), axis=-1)  # [..., E]
+        top_p, top_idx = jax.lax.top_k(probs, K)
+        # renormalize over the selected experts; scatter back to dense [E]
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+        gate = jnp.sum(
+            jax.nn.one_hot(top_idx, E, dtype=probs.dtype) * top_p[..., None],
+            axis=-2,
+        )  # [..., E], zero for unselected experts
+
+        # Switch-style load-balance aux loss over all tokens in the batch
+        flat_gate = gate.reshape(-1, E)
+        frac_tokens = jnp.mean((flat_gate > 0).astype(jnp.float32), axis=0)
+        frac_probs = jnp.mean(probs.reshape(-1, E), axis=0)
+        self.sow("losses", "moe_aux_loss",
+                 E * jnp.sum(frac_tokens * frac_probs))
+
+        xc = x.astype(self.dtype)
+        h = jnp.einsum("...d,edh->e...h", xc, w1.astype(self.dtype))
+        if b1 is not None:
+            h = h + b1.astype(self.dtype).reshape((E,) + (1,) * (x.ndim - 1) + (H,))
+        h = self.act(h)
+        y = jnp.einsum("e...h,eho->e...o", h, w2.astype(self.dtype))
+        if b2 is not None:
+            y = y + b2.astype(self.dtype).reshape((E,) + (1,) * (x.ndim - 1) + (out_dim,))
+        # combine: weighted sum over experts (all-reduce over the expert
+        # mesh axis under GSPMD)
+        gate_e = jnp.moveaxis(gate, -1, 0).astype(self.dtype)  # [E, ...]
+        return jnp.sum(y * gate_e[..., None], axis=0)
+
+
+def make_ffn_layer(kind: str, hidden_dim: int, *, moe_num_experts: int = 8,
+                   moe_top_k: int = 2, **kwargs) -> nn.Module:
     if kind == "mlp":
         return Mlp(hidden_dim=hidden_dim, **kwargs)
     if kind in ("swiglu", "swiglu64", "swiglu128"):
         align = {"swiglu": 8, "swiglu64": 64, "swiglu128": 128}[kind]
         return SwiGLUFFN(hidden_dim=hidden_dim, align_to=align, **kwargs)
+    if kind == "moe":
+        return MoEFFN(hidden_dim=hidden_dim, num_experts=moe_num_experts,
+                      top_k=moe_top_k, **kwargs)
     raise ValueError(f"unknown ffn layer {kind!r}")

@@ -21,8 +21,10 @@ from jax.sharding import Mesh
 # Outer-to-inner order: DCN (slowest) first, tensor (fastest / most
 # communication per byte) last. ``pipe`` (pipeline stages) sits between
 # data and fsdp: its per-microbatch point-to-point transfers are lighter
-# than FSDP all-gathers but heavier than gradient reductions.
-AXES = ("dcn_data", "data", "pipe", "fsdp", "seq", "tensor")
+# than FSDP all-gathers but heavier than gradient reductions. ``expert``
+# (MoE expert parallelism) is innermost with tensor: its combine
+# all-reduce is activation-sized.
+AXES = ("dcn_data", "data", "pipe", "fsdp", "seq", "tensor", "expert")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,6 +36,7 @@ class MeshSpec:
     tensor: int = 1
     seq: int = 1
     pipe: int = 1
+    expert: int = 1
     dcn_data: int = 1
 
     @classmethod
@@ -44,29 +47,33 @@ class MeshSpec:
             tensor=int(parallel_cfg.get("tensor", 1)),
             seq=int(parallel_cfg.get("seq", 1)),
             pipe=int(parallel_cfg.get("pipe", 1)),
+            expert=int(parallel_cfg.get("expert", 1)),
             dcn_data=int(parallel_cfg.get("dcn_data", 1)),
         )
 
     def resolve(self, n_devices: int) -> tuple[int, ...]:
-        """Concrete (dcn_data, data, pipe, fsdp, seq, tensor) sizes."""
-        fixed = self.dcn_data * self.pipe * self.fsdp * self.seq * self.tensor
+        """Concrete (dcn_data, data, pipe, fsdp, seq, tensor, expert)
+        sizes."""
+        fixed = (self.dcn_data * self.pipe * self.fsdp * self.seq
+                 * self.tensor * self.expert)
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
                     f"{n_devices} devices not divisible by "
-                    f"dcn*pipe*fsdp*seq*tensor={fixed}"
+                    f"dcn*pipe*fsdp*seq*tensor*expert={fixed}"
                 )
             data = n_devices // fixed
         total = fixed * data
         if total != n_devices:
             sizes = dict(dcn_data=self.dcn_data, data=data, pipe=self.pipe,
-                         fsdp=self.fsdp, seq=self.seq, tensor=self.tensor)
+                         fsdp=self.fsdp, seq=self.seq, tensor=self.tensor,
+                         expert=self.expert)
             raise ValueError(
                 f"mesh {sizes} needs {total} devices, have {n_devices}"
             )
         return (self.dcn_data, data, self.pipe, self.fsdp, self.seq,
-                self.tensor)
+                self.tensor, self.expert)
 
 
 def build_mesh(

@@ -40,6 +40,11 @@ DEFAULT_LOGICAL_RULES = (
     # params ([n_stages, blocks_per_stage, ...], parallel/pipeline.py) lives
     # on the pipe mesh axis; each pipe device holds and runs its own stage.
     ("stages", "pipe"),
+    # MoE expert parallelism: the leading expert axis of stacked expert
+    # FFN params ([n_experts, ...], ops/ffn.py MoEFFN) lives on the expert
+    # mesh axis; each expert device computes its experts, outputs combine
+    # with an all-reduce over the axis.
+    ("experts", "expert"),
     # scan-over-blocks layer axis stays replicated (sharding it would be
     # FSDP-along-depth: an all-gather per use, not a pipeline).
     ("layers", None),

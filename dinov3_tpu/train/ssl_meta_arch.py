@@ -195,6 +195,17 @@ class SSLMetaArch:
 
     def _apply_backbone(self, module, params, x, masks=None, *, crop_kind,
                         train, rngs=None):
+        if train and getattr(module, "ffn_layer", "") == "moe":
+            # MoE blocks sow their Switch-style load-balance terms into the
+            # "losses" collection; collect them for compute_losses
+            out, aux_vars = module.apply(
+                {"params": params}, x, masks, crop_kind=crop_kind,
+                deterministic=not train, rngs=rngs, mutable=["losses"],
+            )
+            sown = jax.tree.leaves(aux_vars.get("losses", {}))
+            if sown:
+                out["moe_aux_loss"] = sum(jnp.mean(s) for s in sown) / len(sown)
+            return out
         return module.apply(
             {"params": params}, x, masks, crop_kind=crop_kind,
             deterministic=not train, rngs=rngs,
@@ -302,6 +313,10 @@ class SSLMetaArch:
             "cls_after_head": g_logits,
             "masked_patch_after_head": masked_logits.reshape(2 * B, M, -1),
         }
+        if "moe_aux_loss" in g_out or "moe_aux_loss" in l_out:
+            global_out["moe_aux_loss"] = (
+                g_out.get("moe_aux_loss", 0.0) + l_out.get("moe_aux_loss", 0.0)
+            ) / 2.0
         local_out = {
             "cls_pre_head": l_cls.reshape(n_l, B, -1),
             "cls_after_head": l_logits,
@@ -422,6 +437,12 @@ class SSLMetaArch:
             loss_dict["gram_loss"] = g_loss
             loss_dict["gram_loss_weight"] = jnp.asarray(gram_w, jnp.float32)
             total = total + gram_w * g_loss
+
+        if "moe_aux_loss" in student_global:
+            aux_w = float(cfg.student.get("moe_aux_loss_weight", 0.01) or 0.0)
+            aux = student_global["moe_aux_loss"]
+            loss_dict["moe_aux_loss"] = aux
+            total = total + aux_w * aux
 
         loss_dict["total_loss"] = total
         return total, loss_dict

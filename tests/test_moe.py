@@ -1,0 +1,135 @@
+"""MoE FFN + expert parallelism (ops/ffn.py MoEFFN, "expert" mesh axis).
+
+Beyond the reference (SURVEY.md §2.5 "EP — absent"): dense dropless top-k
+routing, Switch-style load-balance aux loss, expert params sharded one
+expert-group per device.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+from dinov3_tpu.data import make_synthetic_batch
+from dinov3_tpu.ops.ffn import Mlp, MoEFFN
+from dinov3_tpu.train import build_train_setup, put_batch
+
+F32 = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def test_moe_forward_shape_and_aux():
+    x = jax.random.normal(jax.random.key(0), (3, 7, 16))
+    moe = MoEFFN(hidden_dim=32, num_experts=4, top_k=2, **F32)
+    params = {"params": moe.init(jax.random.key(1), x)["params"]}
+    y, aux = moe.apply(params, x, mutable=["losses"])
+    assert y.shape == x.shape and bool(jnp.isfinite(y).all())
+    (aux_loss,) = jax.tree.leaves(aux["losses"])
+    # Switch aux loss is minimized at perfectly uniform routing where it
+    # equals top_k (each token selects k experts; sum_e f_e = k)
+    assert float(aux_loss) >= 2.0 - 1e-3
+
+
+def test_moe_topk_equals_experts_is_dense_mixture():
+    """top_k == E: gate = softmax probs, output = prob-weighted expert mix.
+    Check against a manual per-expert computation."""
+    x = jax.random.normal(jax.random.key(0), (2, 5, 8))
+    moe = MoEFFN(hidden_dim=16, num_experts=3, top_k=3, act=lambda t: t, **F32)
+    import flax.linen as nn
+
+    params = nn.meta.unbox(moe.init(jax.random.key(1), x))["params"]
+    y = moe.apply({"params": params}, x)
+
+    probs = jax.nn.softmax(
+        np.asarray(x) @ np.asarray(params["router"]["kernel"]), axis=-1
+    )
+    manual = np.zeros_like(np.asarray(x))
+    for e in range(3):
+        h = np.asarray(x) @ np.asarray(params["w1"][e]) + np.asarray(params["b1"][e])
+        o = h @ np.asarray(params["w2"][e]) + np.asarray(params["b2"][e])
+        manual += probs[..., e:e + 1] * o
+    np.testing.assert_allclose(np.asarray(y), manual, atol=1e-4)
+
+
+def test_moe_topk_sparsity():
+    """top_k=1: each token's output is exactly one expert's output."""
+    x = jax.random.normal(jax.random.key(0), (1, 4, 8))
+    moe = MoEFFN(hidden_dim=16, num_experts=4, top_k=1, act=lambda t: t, **F32)
+    import flax.linen as nn
+
+    params = nn.meta.unbox(moe.init(jax.random.key(1), x))["params"]
+    y = np.asarray(moe.apply({"params": params}, x))
+    probs = jax.nn.softmax(
+        np.asarray(x) @ np.asarray(params["router"]["kernel"]), axis=-1
+    )
+    chosen = np.argmax(probs, axis=-1)
+    for b in range(1):
+        for t in range(4):
+            e = chosen[b, t]
+            h = np.asarray(x[b, t]) @ np.asarray(params["w1"][e]) + np.asarray(params["b1"][e])
+            o = h @ np.asarray(params["w2"][e]) + np.asarray(params["b2"][e])
+            np.testing.assert_allclose(y[b, t], o, atol=1e-4)
+
+
+SMOL_MOE = [
+    "student.arch=vit_test", "student.patch_size=4",
+    "student.drop_path_rate=0.0", "student.layerscale=1.0e-5",
+    "student.ffn_layer=moe", "student.moe_num_experts=2",
+    "student.moe_top_k=1",
+    "crops.global_crops_size=16", "crops.local_crops_size=8",
+    "crops.local_crops_number=2",
+    "dino.head_n_prototypes=32", "dino.head_hidden_dim=24",
+    "dino.head_bottleneck_dim=8",
+    "ibot.head_n_prototypes=32", "ibot.head_hidden_dim=24",
+    "ibot.head_bottleneck_dim=8",
+    "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=4",
+    "optim.warmup_epochs=1", "compute_precision.compute_dtype=fp32",
+    "optim.scaling_rule=none",
+]
+
+
+def test_moe_train_step_expert_parallel(eight_devices):
+    """Full SSL step with MoE blocks under (data, fsdp, expert) sharding:
+    expert params land sharded over the expert axis, losses include the
+    aux term, loss finite over two steps."""
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, SMOL_MOE + [
+        "parallel.data=2", "parallel.fsdp=2", "parallel.expert=2",
+    ])
+    B = 8
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, B, seed=0).items()}
+    setup = build_train_setup(cfg, batch, devices=eight_devices)
+    assert setup.mesh.shape["expert"] == 2
+
+    # expert-stacked ffn params sharded over the expert axis
+    blk0 = setup.state_shardings.params["student"]["backbone"]["blocks_0"]["mlp"]
+    def has_expert(s):
+        return any(
+            "expert" in (ax if isinstance(ax, tuple) else (ax,))
+            for ax in s.spec if ax is not None
+        )
+    expert_leaves = [s for k, s in blk0.items() if k in ("w1", "w2", "b1", "b2")]
+    assert expert_leaves and all(has_expert(s) for s in expert_leaves), blk0
+
+    dbatch = put_batch(batch, setup.batch_shardings)
+    state, metrics = setup.step_fn(
+        setup.state, dbatch, setup.scalars(0), jax.random.key(0)
+    )
+    assert "moe_aux_loss" in metrics
+    assert np.isfinite(float(metrics["total_loss"]))
+    state, metrics = setup.step_fn(
+        state, dbatch, setup.scalars(1), jax.random.key(0)
+    )
+    assert np.isfinite(float(metrics["total_loss"]))
+
+
+def test_moe_rejects_scan_and_pipeline(eight_devices):
+    from dinov3_tpu.models import build_backbone
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, SMOL_MOE + ["train.scan_layers=true"])
+    model = build_backbone(cfg)
+    x = jnp.zeros((1, 16, 16, 3))
+    with pytest.raises(NotImplementedError, match="moe"):
+        model.init(jax.random.key(0), x)

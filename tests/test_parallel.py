@@ -40,9 +40,10 @@ def smol_cfg(extra=()):
 
 
 def test_mesh_spec_resolution(eight_devices):
-    assert MeshSpec(data=-1, fsdp=2).resolve(8) == (1, 4, 1, 2, 1, 1)
-    assert MeshSpec(data=2, fsdp=2, seq=2).resolve(8) == (1, 2, 1, 2, 2, 1)
-    assert MeshSpec(data=2, pipe=2, fsdp=2).resolve(8) == (1, 2, 2, 2, 1, 1)
+    assert MeshSpec(data=-1, fsdp=2).resolve(8) == (1, 4, 1, 2, 1, 1, 1)
+    assert MeshSpec(data=2, fsdp=2, seq=2).resolve(8) == (1, 2, 1, 2, 2, 1, 1)
+    assert MeshSpec(data=2, pipe=2, fsdp=2).resolve(8) == (1, 2, 2, 2, 1, 1, 1)
+    assert MeshSpec(data=2, fsdp=2, expert=2).resolve(8) == (1, 2, 1, 2, 1, 1, 2)
     with pytest.raises(ValueError):
         MeshSpec(data=3, fsdp=2).resolve(8)
     mesh = build_mesh(MeshSpec(data=-1, fsdp=2), devices=eight_devices)

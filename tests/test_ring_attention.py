@@ -12,8 +12,9 @@ from dinov3_tpu.parallel.ring_attention import ring_attention
 
 def _mesh(eight_devices, seq):
     rest = 8 // seq
-    arr = np.array(eight_devices).reshape(1, rest, 1, 1, seq, 1)
-    return Mesh(arr, ("dcn_data", "data", "pipe", "fsdp", "seq", "tensor"))
+    arr = np.array(eight_devices).reshape(1, rest, 1, 1, seq, 1, 1)
+    return Mesh(arr, ("dcn_data", "data", "pipe", "fsdp", "seq", "tensor",
+                      "expert"))
 
 
 def _qkv(rng, B, N, h, d, dtype=jnp.float32):
