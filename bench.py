@@ -11,9 +11,10 @@ its configs record Meta's PyTorch run at 0.57 s/iter for global batch 2048
 on 32 A100-class GPUs = 112 img/s/GPU (vitl_im1k_lin834.yaml:3-4).
 ``vs_baseline`` is img/s/chip divided by that 112 img/s/GPU anchor.
 
-Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 8 — largest
-that fits a 16G v5e chip without remat; remat admits 32 but is net
-slower), BENCH_STEPS (10), BENCH_WARMUP (3).
+Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 8 — the
+throughput peak on a 16G v5e: measured 54.4 img/s at B=6, 58.9 at B=8,
+57.6 at B=10, 54.1 at B=12, 52.9 at B=16; remat variants are net slower),
+BENCH_STEPS (10), BENCH_WARMUP (3).
 """
 
 from __future__ import annotations
@@ -40,6 +41,9 @@ def main():
     per_chip = int(os.environ.get("BENCH_BATCH", "8"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    res = int(os.environ.get("BENCH_RES", "0"))  # >0: global crop px
+    # (e.g. BENCH_RES=512 BENCH_BATCH=2 exercises the >=1024-token flash-
+    # attention regime of the high-res recipes)
 
     n = jax.device_count()
     cfg = get_default_config()
@@ -52,7 +56,8 @@ def main():
         # bf16 parameter storage, as in the reference's own recipe
         # (vitl_im1k_lin834.yaml compute_precision.param_dtype: bf16)
         "compute_precision.param_dtype=bf16",
-    ])
+    ] + ([f"crops.global_crops_size={res}",
+          f"crops.local_crops_size={max(96, res // 4)}"] if res else []))
     B = per_chip * n
     batch_np = make_synthetic_batch(cfg, B, seed=0)
     batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
@@ -76,8 +81,9 @@ def main():
     dt = (time.perf_counter() - t0) / steps
 
     img_s_chip = B / dt / n
+    tag = f"{arch}_{res}px" if res else arch
     print(json.dumps({
-        "metric": f"dinov3_pretrain_{arch}_imgs_per_sec_per_chip",
+        "metric": f"dinov3_pretrain_{tag}_imgs_per_sec_per_chip",
         "value": round(img_s_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),

@@ -1,0 +1,78 @@
+"""ADE20K and COCO-Captions dataset I/O on tiny on-disk fixtures.
+
+The reference stubbed both to random arrays (SURVEY.md §2.6:
+data/datasets/ade20k.py:56-60); these tests pin the real file layouts.
+"""
+
+import json
+import os
+
+import numpy as np
+from PIL import Image
+
+from dinov3_tpu.data.datasets.ade20k import ADE20K
+from dinov3_tpu.data.datasets.coco_captions import CocoCaptions
+
+
+def _write_img(path, size=(16, 12), value=128):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    Image.new("RGB", size, (value, value // 2, 20)).save(path)
+
+
+def test_ade20k_reads_images_and_segmaps(tmp_path):
+    root = str(tmp_path)
+    for i in range(3):
+        _write_img(f"{root}/images/validation/img_{i}.jpg", value=50 + i)
+        seg = Image.fromarray(
+            np.full((12, 16), i, np.uint8), mode="L"
+        )
+        os.makedirs(f"{root}/annotations/validation", exist_ok=True)
+        seg.save(f"{root}/annotations/validation/img_{i}.png")
+
+    ds = ADE20K(root=root, split="VAL")
+    assert len(ds) == 3
+    image, seg = ds[1]
+    assert image.size == (16, 12)
+    assert seg.shape == (12, 16) and int(seg.max()) == 1
+
+    # missing annotation -> image still served, target None
+    _write_img(f"{root}/images/validation/img_9.jpg")
+    ds = ADE20K(root=root, split="VAL")
+    image, seg = ds[len(ds) - 1]
+    assert seg is None
+
+
+def test_ade20k_missing_root_raises(tmp_path):
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        ADE20K(root=str(tmp_path / "nope"), split="VAL")
+
+
+def test_coco_captions_groups_by_image(tmp_path):
+    root = str(tmp_path)
+    for i in range(2):
+        _write_img(f"{root}/img_{i}.jpg")
+    meta = {
+        "images": [
+            {"id": 7, "file_name": "img_0.jpg"},
+            {"id": 3, "file_name": "img_1.jpg"},
+        ],
+        "annotations": [
+            {"image_id": 7, "caption": "a red square"},
+            {"image_id": 7, "caption": "still a red square"},
+            {"image_id": 3, "caption": "another image"},
+        ],
+    }
+    ann = str(tmp_path / "captions.json")
+    with open(ann, "w") as f:
+        json.dump(meta, f)
+
+    ds = CocoCaptions(root=root, annotations=ann)
+    assert len(ds) == 2
+    # ids sorted: index 0 -> id 3, index 1 -> id 7
+    img, caps = ds[0]
+    assert caps == ["another image"]
+    img, caps = ds[1]
+    assert sorted(caps) == ["a red square", "still a red square"]
+    assert img.size == (16, 12)
