@@ -125,13 +125,29 @@ class SmoothedValue:
 class MetricLogger:
     """Iteration driver printing smoothed meters + ETA, dumping JSON lines.
 
-    (reference: logging/helpers.py:86-197.)
+    (reference: logging/helpers.py:86-197. The reference also listed
+    tensorboard in requirements.txt:53 but never imported it — SURVEY.md
+    §5.5; here ``tensorboard_dir`` wires a real event writer, gated on the
+    package being importable.)
     """
 
-    def __init__(self, delimiter: str = "  ", output_file: str | None = None):
+    def __init__(self, delimiter: str = "  ", output_file: str | None = None,
+                 tensorboard_dir: str | None = None):
         self.meters: dict[str, SmoothedValue] = defaultdict(SmoothedValue)
         self.delimiter = delimiter
         self.output_file = output_file
+        self._tb = None
+        if tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=tensorboard_dir)
+            except ImportError:
+                logger.warning(
+                    "tensorboard_dir=%s set but tensorboard is not "
+                    "importable; falling back to JSON-lines only",
+                    tensorboard_dir,
+                )
 
     def update(self, **kwargs) -> None:
         for k, v in kwargs.items():
@@ -139,12 +155,20 @@ class MetricLogger:
                 v = float(v)
             self.meters[k].update(float(v))
 
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+
     def __getattr__(self, attr):
         if attr in self.meters:
             return self.meters[attr]
         raise AttributeError(attr)
 
     def dump_json(self, iteration: int, iter_time: float, data_time: float) -> None:
+        if self._tb is not None:
+            for k, m in self.meters.items():
+                self._tb.add_scalar(k, m.median, iteration)
+            self._tb.add_scalar("iter_time", iter_time, iteration)
         if not self.output_file:
             return
         entry = {

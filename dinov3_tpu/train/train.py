@@ -65,6 +65,9 @@ def get_args_parser():
                    help="run two diagnostic steps on one batch (losses "
                         "finite, every submodule trains, teacher EMA "
                         "tracks) and exit")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="mirror metrics to <output-dir>/tb tensorboard "
+                        "events in addition to training_metrics.json")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans: the first op producing a "
                         "NaN raises with its location (slower; de-fuses "
@@ -176,6 +179,8 @@ def do_train(cfg, args) -> dict:
     metric_logger = MetricLogger(
         output_file=f"{cfg.train.output_dir}/training_metrics.json"
         if is_main_process() else None,
+        tensorboard_dir=f"{cfg.train.output_dir}/tb"
+        if (args.tensorboard and is_main_process()) else None,
     )
     rng = jax.random.key(cfg.train.seed + 1)
     nan_streak = 0
@@ -270,6 +275,7 @@ def do_train(cfg, args) -> dict:
             break
 
     preemption.__exit__()
+    metric_logger.close()
     ckpt.close()
     result = {"final_loss": last_loss, "iterations": int(state.step)}
     if recorder is not None:
