@@ -83,14 +83,26 @@ def _parse_value(text: str) -> Any:
 
 
 def apply_dot_overrides(cfg: ConfigNode, overrides: Iterable[str]) -> ConfigNode:
-    """Apply ``a.b.c=value`` overrides in place; numeric components index lists."""
+    """Apply ``a.b.c=value`` overrides in place; numeric components index
+    lists.
+
+    Strict against the schema (the reference's OmegaConf ``set_struct``,
+    configs/config.py:84): a key path whose parent section or leaf key does
+    not already exist raises, so ``optim.lrr=0.1`` cannot silently train
+    with the default lr. Prefix with ``+`` (``+extras.tag=v``) to add a
+    genuinely new key.
+    """
     for item in overrides:
         if "=" not in item:
             raise ValueError(f"override {item!r} is not of the form key.path=value")
         path, _, raw = item.partition("=")
-        keys = path.strip().split(".")
+        path = path.strip()
+        allow_new = path.startswith("+")
+        if allow_new:
+            path = path[1:]
+        keys = path.split(".")
         node = cfg
-        for k in keys[:-1]:
+        for depth, k in enumerate(keys[:-1]):
             if isinstance(node, list):
                 node = node[int(k)]
                 continue
@@ -99,6 +111,12 @@ def apply_dot_overrides(cfg: ConfigNode, overrides: Iterable[str]) -> ConfigNode
                 node = nxt
                 continue
             if not isinstance(nxt, dict):
+                if nxt is None and k not in node and not allow_new:
+                    raise KeyError(
+                        f"override {item!r}: unknown section "
+                        f"{'.'.join(keys[:depth + 1])!r} (prefix with '+' "
+                        "to add new keys)"
+                    )
                 nxt = ConfigNode()
                 node[k] = nxt
             elif not isinstance(nxt, ConfigNode):
@@ -110,6 +128,11 @@ def apply_dot_overrides(cfg: ConfigNode, overrides: Iterable[str]) -> ConfigNode
         if isinstance(node, list):
             node[int(leaf)] = value
         else:
+            if not allow_new and leaf not in node:
+                raise KeyError(
+                    f"override {item!r}: unknown key {path!r} (prefix "
+                    "with '+' to add new keys)"
+                )
             node[leaf] = value
     return cfg
 

@@ -1,3 +1,4 @@
+import pytest
 import yaml
 
 from dinov3_tpu.configs import (
@@ -99,3 +100,21 @@ def test_model_parallel_excluded_from_global_batch():
                               "parallel.tensor=8"])
     # 8 CPU devices / tensor=8 -> 1 data shard
     assert global_batch_size(cfg) == 4
+
+
+def test_dot_overrides_reject_unknown_keys():
+    """Typos cannot silently train with defaults (the reference's
+    OmegaConf set_struct strictness, configs/config.py:84)."""
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+
+    cfg = get_default_config()
+    with pytest.raises(KeyError, match="lrr"):
+        apply_dot_overrides(cfg, ["optim.lrr=0.1"])
+    with pytest.raises(KeyError, match="brandnew"):
+        apply_dot_overrides(cfg, ["brandnew.section=1"])
+    # '+' prefix opts in to genuinely new keys
+    apply_dot_overrides(cfg, ["+extras.tag=v1"])
+    assert cfg.extras.tag == "v1"
+    # nested-but-existing sections still work, including null sections
+    apply_dot_overrides(cfg, ["optim.lr=0.5"])
+    assert cfg.optim.lr == 0.5
