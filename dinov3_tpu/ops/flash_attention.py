@@ -43,13 +43,27 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = -1e30
 
+# Upper bounds for the q/kv block sizes, configurable via
+# ``kernels.flash_block_q`` / ``kernels.flash_block_kv``
+# (set_flash_block_caps is called by the train setup); the concrete block
+# is the largest 128-multiple divisor of n_padded within the cap.
+_BLOCK_CAPS = [512, 512]  # [q, kv]
+
+
+def set_flash_block_caps(block_q: int = 512, block_kv: int = 512) -> None:
+    _BLOCK_CAPS[0] = max(128, int(block_q))
+    _BLOCK_CAPS[1] = max(128, int(block_kv))
+
+
+def _pick(n_padded: int, cap: int) -> int:
+    for c in (512, 256, 128):
+        if c <= cap and n_padded % c == 0:
+            return c
+    raise ValueError(f"n_padded={n_padded} is not a multiple of 128")
+
 
 def _block_sizes(n_padded: int) -> tuple[int, int]:
-    """Largest of (512, 256, 128) that divides n_padded (a 128-multiple)."""
-    for c in (512, 256, 128):
-        if n_padded % c == 0:
-            return c, c
-    raise ValueError(f"n_padded={n_padded} is not a multiple of 128")
+    return _pick(n_padded, _BLOCK_CAPS[0]), _pick(n_padded, _BLOCK_CAPS[1])
 
 
 def _vmem_spec(block_shape=None, index_map=None):

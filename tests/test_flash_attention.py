@@ -77,3 +77,21 @@ def test_jit_and_vit_shapes(rng):
         return flash_attention(q, k, v, interpret=True).sum()
 
     assert jnp.isfinite(f(q, k, v))
+
+
+def test_flash_block_caps_honored():
+    """kernels.flash_block_q/kv cap the kernel block sizes (they were
+    previously declared in the schema but never consumed)."""
+    from dinov3_tpu.ops.flash_attention import (
+        _block_sizes,
+        set_flash_block_caps,
+    )
+
+    try:
+        set_flash_block_caps(128, 256)
+        assert _block_sizes(1024) == (128, 256)
+        set_flash_block_caps(512, 512)
+        assert _block_sizes(1024) == (512, 512)
+        assert _block_sizes(1152) == (128, 128)  # 1152 = 9*128
+    finally:
+        set_flash_block_caps(512, 512)
