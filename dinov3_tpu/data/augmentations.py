@@ -196,9 +196,16 @@ def build_augmentation_from_cfg(cfg) -> DataAugmentationDINO:
             crops.get("gram_teacher_no_distortions", False)),
         teacher_no_color_jitter=bool(
             cfg.train.get("teacher_no_color_jitter", False)),
+        # schema key spelling follows the reference yaml
+        # (localcrops_subset_of_globalcrops); either-truthy honors configs
+        # written with the underscored spelling too — the schema default
+        # (false) would otherwise shadow them
         local_crops_subset_of_global_crops=bool(
-            crops.get("local_crops_subset_of_global_crops", False)),
+            crops.get("localcrops_subset_of_globalcrops", False)
+            or crops.get("local_crops_subset_of_global_crops", False)),
         patch_size=cfg.student.patch_size,
         share_color_jitter=bool(crops.get("share_color_jitter", False)),
         horizontal_flips=bool(crops.get("horizontal_flips", True)),
+        mean=tuple(crops.get("rgb_mean") or IMAGENET_MEAN),
+        std=tuple(crops.get("rgb_std") or IMAGENET_STD),
     )

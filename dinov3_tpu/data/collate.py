@@ -27,6 +27,7 @@ def collate_crops(
     global_crops_size: int,
     mask_ratio_min_max: tuple[float, float] = (0.1, 0.5),
     mask_probability: float = 0.5,
+    mask_random_circular_shift: bool = False,
     dtype=np.float32,
 ) -> dict:
     """samples: augmentation outputs (dicts of lists of HWC arrays).
@@ -78,6 +79,7 @@ def collate_crops(
         grid=(grid, grid),
         mask_ratio_min_max=tuple(mask_ratio_min_max),
         mask_probability=mask_probability,
+        random_circular_shift=mask_random_circular_shift,
     )
     batch["masks"] = masks
     batch["mask_indices"] = idx

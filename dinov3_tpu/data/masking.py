@@ -69,13 +69,17 @@ def sample_ibot_masks(
     grid: tuple[int, int],
     mask_ratio_min_max: tuple[float, float] = (0.1, 0.5),
     mask_probability: float = 0.5,
+    random_circular_shift: bool = False,
 ):
     """Sample per-image block masks and pack fixed-capacity buffers.
 
     A ``mask_probability`` fraction of images is masked, with per-masked-image
     ratios spread linearly across [min, max] (reference collate.py:47-65's
-    linspaced probabilities). Returns (masks [N, T] bool,
-    indices [N, C] int32, weights [N, C] f32, valid [N, C] bool).
+    linspaced probabilities). ``random_circular_shift`` rolls each block
+    mask by a random 2-D offset (reference config
+    ibot.mask_random_circular_shift) so block positions lose their
+    center bias. Returns (masks [N, T] bool, indices [N, C] int32,
+    weights [N, C] f32, valid [N, C] bool).
     """
     lo, hi = mask_ratio_min_max
     n_masked_images = int(round(n_images * mask_probability))
@@ -88,7 +92,14 @@ def sample_ibot_masks(
     for j in range(n_masked_images):
         img = order[j]
         n_target = min(int(round(ratios[j] * n_tokens)), capacity)
-        m = block_mask(rng, grid, n_target).reshape(-1)
+        m2 = block_mask(rng, grid, n_target)
+        if random_circular_shift:
+            m2 = np.roll(
+                m2,
+                (int(rng.integers(grid[0])), int(rng.integers(grid[1]))),
+                axis=(0, 1),
+            )
+        m = m2.reshape(-1)
         masks[img] = m
         idx = np.flatnonzero(m)[:capacity]
         k = len(idx)

@@ -34,6 +34,8 @@ def _collate_for_cfg(cfg, samples_with_targets, rng: np.random.Generator):
         global_crops_size=cfg.crops.global_crops_size,
         mask_ratio_min_max=tuple(cfg.ibot.mask_ratio_min_max),
         mask_probability=cfg.ibot.mask_sample_probability,
+        mask_random_circular_shift=bool(
+            cfg.ibot.get("mask_random_circular_shift", False)),
     )
 
 

@@ -60,6 +60,8 @@ def make_synthetic_batch(
         grid=(S // p, S // p),
         mask_ratio_min_max=tuple(cfg.ibot.mask_ratio_min_max),
         mask_probability=cfg.ibot.mask_sample_probability,
+        random_circular_shift=bool(
+            cfg.ibot.get("mask_random_circular_shift", False)),
     )
     batch["masks"] = masks
     batch["mask_indices"] = idx

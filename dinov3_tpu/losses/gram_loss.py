@@ -4,7 +4,11 @@
 branch used torch in-place indexing (broken under JAX, SURVEY.md §2.9.6)
 and whose setup asserted ``remove_neg != remove_only_teacher_neg``, failing
 the default False/False config. Both fixed: functional ``jnp.where``
-clipping, and False/False simply clips nothing.)
+clipping, and False/False simply clips nothing. ``token_mask`` implements
+the reference's ``gram.tokens_used`` masked/unmasked restriction
+(ssl_meta_arch.py:221-222) with static shapes: deselected token rows are
+zeroed — their similarity entries vanish identically for student and
+teacher — and the mean is taken over selected-pair count only.)
 """
 
 from __future__ import annotations
@@ -19,15 +23,20 @@ def gram_loss(
     img_level: bool = True,
     remove_neg: bool = False,
     remove_only_teacher_neg: bool = False,
+    token_mask: jnp.ndarray | None = None,
     reduce_dtype=jnp.float32,
 ) -> jnp.ndarray:
     """MSE between patch-similarity (Gram) matrices.
 
     feats: [B, T, D]. ``img_level`` computes per-image [T, T] Grams;
     otherwise tokens are flattened to one [B*T, B*T] Gram.
+    ``token_mask``: optional [B, T] bool selecting the tokens that enter
+    the Gram (requires ``img_level=False``, as in the reference).
     """
     if remove_neg and remove_only_teacher_neg:
         raise ValueError("remove_neg and remove_only_teacher_neg are exclusive")
+    if token_mask is not None and img_level:
+        raise ValueError("token_mask requires img_level=False")
     s = student_feats.astype(reduce_dtype)
     t = teacher_feats.astype(reduce_dtype)
     if normalize:
@@ -35,6 +44,11 @@ def gram_loss(
 
         s = l2_normalize(s)  # zero-safe gradient (ops/common.py)
         t = l2_normalize(t)
+    w = None
+    if token_mask is not None:
+        w = token_mask.astype(reduce_dtype).reshape(-1)  # [B*T]
+        s = s * token_mask[..., None].astype(s.dtype)
+        t = t * token_mask[..., None].astype(t.dtype)
     if not img_level:
         s = s.reshape(-1, s.shape[-1])
         t = t.reshape(-1, t.shape[-1])
@@ -46,4 +60,8 @@ def gram_loss(
     elif remove_only_teacher_neg:
         s_sim = jnp.where((s_sim < 0.0) & (t_sim < 0.0), 0.0, s_sim)
         t_sim = jnp.maximum(t_sim, 0.0)
-    return jnp.mean((s_sim - t_sim) ** 2)
+    sq = (s_sim - t_sim) ** 2
+    if w is None:
+        return jnp.mean(sq)
+    n = jnp.sum(w)
+    return jnp.sum(sq) / jnp.maximum(n * n, 1.0)

@@ -83,6 +83,8 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
         )
     kernels = cfg.get("kernels") or {}
     kw["attn_impl"] = kernels.get("flash_attention", "auto")
+    kw["flash_block_q"] = int(kernels.get("flash_block_q", 512) or 512)
+    kw["flash_block_kv"] = int(kernels.get("flash_block_kv", 512) or 512)
     parallel = cfg.get("parallel") or {}
     kw["seq_parallel"] = int(parallel.get("seq", 1) or 1) > 1
     kw["pipeline_stages"] = int(parallel.get("pipe", 1) or 1)

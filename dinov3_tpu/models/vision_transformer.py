@@ -95,6 +95,8 @@ class DinoVisionTransformer(nn.Module):
     pos_embed_rope_dtype: str = "fp32"
     # execution
     attn_impl: str = "auto"
+    flash_block_q: int = 512   # kernels.flash_block_q/kv caps
+    flash_block_kv: int = 512
     seq_parallel: bool = False
     scan_layers: bool = False
     pipeline_stages: int = 1       # >1: GPipe pipeline over the pipe axis
@@ -190,6 +192,8 @@ class DinoVisionTransformer(nn.Module):
             drop_path_rate=self.drop_path_rate,
             layerscale_init=self.layerscale_init,
             mask_k_bias=self.mask_k_bias, attn_impl=self.attn_impl,
+            flash_block_q=self.flash_block_q,
+            flash_block_kv=self.flash_block_kv,
             seq_parallel=self.seq_parallel, fp8=self.fp8,
             moe_num_experts=self.moe_num_experts, moe_top_k=self.moe_top_k,
             dtype=self.dtype, param_dtype=self.param_dtype,

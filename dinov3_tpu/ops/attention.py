@@ -81,6 +81,7 @@ FLASH_MIN_SEQ = 1024
 def dispatch_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     impl: str = "auto", reduce_dtype=jnp.float32,
+    flash_block_q: int = 512, flash_block_kv: int = 512,
 ) -> jnp.ndarray:
     if impl == "auto":
         impl = (
@@ -97,7 +98,8 @@ def dispatch_attention(
     if impl == "pallas":
         from dinov3_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v)
+        return flash_attention(q, k, v, block_q=flash_block_q,
+                               block_kv=flash_block_kv)
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
@@ -112,6 +114,8 @@ class SelfAttention(nn.Module):
     seq_parallel: bool = False
     fp8: bool = False  # current-scaling fp8 projections (ops/common.py)
     causal: bool = False  # triangular mask (dense XLA path only)
+    flash_block_q: int = 512   # kernels.flash_block_q/kv caps
+    flash_block_kv: int = 512
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -176,7 +180,11 @@ class SelfAttention(nn.Module):
                 out = ring_attention(q, k, v, mesh,
                                      reduce_dtype=self.reduce_dtype)
         if out is None:
-            out = dispatch_attention(q, k, v, self.attn_impl, self.reduce_dtype)
+            out = dispatch_attention(
+                q, k, v, self.attn_impl, self.reduce_dtype,
+                flash_block_q=self.flash_block_q,
+                flash_block_kv=self.flash_block_kv,
+            )
         out = constrain(out.reshape(B, N, self.dim), ("batch", None, "embed_act"))
 
         proj_kernel = self.param(

@@ -37,6 +37,8 @@ class SelfAttentionBlock(nn.Module):
     causal: bool = False
     moe_num_experts: int = 8   # only used when ffn_layer == "moe"
     moe_top_k: int = 2
+    flash_block_q: int = 512
+    flash_block_kv: int = 512
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -60,7 +62,9 @@ class SelfAttentionBlock(nn.Module):
             dim=self.dim, num_heads=self.num_heads, qkv_bias=self.qkv_bias,
             proj_bias=self.proj_bias, mask_k_bias=self.mask_k_bias,
             attn_impl=self.attn_impl, seq_parallel=self.seq_parallel,
-            fp8=self.fp8, causal=self.causal, dtype=self.dtype,
+            fp8=self.fp8, causal=self.causal,
+            flash_block_q=self.flash_block_q,
+            flash_block_kv=self.flash_block_kv, dtype=self.dtype,
             param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
             name="attn",
         )(make_norm_layer(self.norm_layer, name="norm1", **norm_kw)(x),

@@ -43,17 +43,6 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = -1e30
 
-# Upper bounds for the q/kv block sizes, configurable via
-# ``kernels.flash_block_q`` / ``kernels.flash_block_kv``
-# (set_flash_block_caps is called by the train setup); the concrete block
-# is the largest 128-multiple divisor of n_padded within the cap.
-_BLOCK_CAPS = [512, 512]  # [q, kv]
-
-
-def set_flash_block_caps(block_q: int = 512, block_kv: int = 512) -> None:
-    _BLOCK_CAPS[0] = max(128, int(block_q))
-    _BLOCK_CAPS[1] = max(128, int(block_kv))
-
 
 def _pick(n_padded: int, cap: int) -> int:
     for c in (512, 256, 128):
@@ -62,8 +51,12 @@ def _pick(n_padded: int, cap: int) -> int:
     raise ValueError(f"n_padded={n_padded} is not a multiple of 128")
 
 
-def _block_sizes(n_padded: int) -> tuple[int, int]:
-    return _pick(n_padded, _BLOCK_CAPS[0]), _pick(n_padded, _BLOCK_CAPS[1])
+def _block_sizes(n_padded: int, block_q: int = 512,
+                 block_kv: int = 512) -> tuple[int, int]:
+    """Concrete q/kv block sizes: the largest 128-multiple divisor of
+    n_padded within the configured caps (``kernels.flash_block_q/kv``)."""
+    return (_pick(n_padded, max(128, int(block_q))),
+            _pick(n_padded, max(128, int(block_kv))))
 
 
 def _vmem_spec(block_shape=None, index_map=None):
@@ -113,10 +106,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, n_valid, bk):
     lse_ref[...] = m + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, *, n_valid, interpret):
+def _flash_fwd(q, k, v, *, n_valid, interpret, caps=(512, 512)):
     """q, k, v: [BH, Np, d] fp32/bf16; returns (o, lse)."""
     bh, n_padded, d = q.shape
-    bq, bk = _block_sizes(n_padded)
+    bq, bk = _block_sizes(n_padded, *caps)
     scale = d ** -0.5
     kernel = functools.partial(
         _fwd_kernel, scale=scale, n_valid=n_valid, bk=bk
@@ -228,13 +221,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # ------------------------------------------------------------ public entry
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_bhnd(q, k, v, interpret):
-    o, _ = _fwd_pallas(q, k, v, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhnd(q, k, v, interpret, caps):
+    o, _ = _fwd_pallas(q, k, v, interpret, caps)
     return o
 
 
-def _fwd_pallas(q, k, v, interpret):
+def _fwd_pallas(q, k, v, interpret, caps=(512, 512)):
     n_valid = q.shape[1]
     n_padded = _round_up(n_valid, 128)
     pad = n_padded - n_valid
@@ -243,16 +236,17 @@ def _fwd_pallas(q, k, v, interpret):
         q = jnp.pad(q, padcfg)
         k = jnp.pad(k, padcfg)
         v = jnp.pad(v, padcfg)
-    o, lse = _flash_fwd(q, k, v, n_valid=n_valid, interpret=interpret)
+    o, lse = _flash_fwd(q, k, v, n_valid=n_valid, interpret=interpret,
+                        caps=caps)
     return o[:, :n_valid], (q, k, v, o, lse, n_valid)
 
 
-def _flash_bhnd_fwd(q, k, v, interpret):
-    o, res = _fwd_pallas(q, k, v, interpret)
+def _flash_bhnd_fwd(q, k, v, interpret, caps):
+    o, res = _fwd_pallas(q, k, v, interpret, caps)
     return o, res
 
 
-def _flash_bhnd_bwd(interpret, res, do):
+def _flash_bhnd_bwd(interpret, caps, res, do):
     q, k, v, o, lse, n_valid = res  # padded to Np
     bh, n_padded, d = q.shape
     pad = n_padded - n_valid
@@ -260,7 +254,7 @@ def _flash_bhnd_bwd(interpret, res, do):
         do = jnp.pad(do, ((0, 0), (0, pad), (0, 0)))
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    bq, bk = _block_sizes(n_padded)
+    bq, bk = _block_sizes(n_padded, *caps)
     scale = d ** -0.5
 
     dq = pl.pallas_call(
@@ -318,15 +312,20 @@ def flash_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     interpret: bool | None = None,
+    block_q: int = 512,
+    block_kv: int = 512,
 ) -> jnp.ndarray:
     """Fused attention. q, k, v: [B, N, heads, d] -> [B, N, heads, d].
 
     Softmax statistics accumulate in fp32 regardless of input dtype.
     ``interpret`` defaults to True off-TPU so CPU tests run the same code.
+    ``block_q``/``block_kv`` cap the kernel block sizes
+    (``kernels.flash_block_q/kv``; actual = largest divisor within cap).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, N, h, d = q.shape
     to_bhnd = lambda t: t.transpose(0, 2, 1, 3).reshape(B * h, N, d)
-    o = _flash_bhnd(to_bhnd(q), to_bhnd(k), to_bhnd(v), interpret)
+    o = _flash_bhnd(to_bhnd(q), to_bhnd(k), to_bhnd(v), interpret,
+                    (int(block_q), int(block_kv)))
     return o.reshape(B, h, N, d).transpose(0, 2, 1, 3)

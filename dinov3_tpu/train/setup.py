@@ -71,16 +71,6 @@ def build_train_setup(
     from dinov3_tpu.parallel.context import set_current_mesh
 
     set_current_mesh(mesh)
-    kernels = cfg.get("kernels") or {}
-    try:
-        from dinov3_tpu.ops.flash_attention import set_flash_block_caps
-
-        set_flash_block_caps(
-            kernels.get("flash_block_q", 512),
-            kernels.get("flash_block_kv", 512),
-        )
-    except ImportError:
-        pass
     meta = SSLMetaArch(cfg)
     schedules = build_schedules(cfg)
 

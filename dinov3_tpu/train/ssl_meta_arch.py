@@ -427,16 +427,41 @@ class SSLMetaArch:
             if self.gram_weight_schedule is not None:
                 sched = jnp.asarray(self.gram_weight_schedule, jnp.float32)
                 gram_w = sched[jnp.minimum(iteration, sched.shape[0] - 1)]
-            g_loss = gram_loss(
-                student_global["patch_pre_head"], gram_feats,
+            gram_kw = dict(
                 normalize=cfg.gram.normalized,
-                img_level=cfg.gram.img_level,
                 remove_neg=cfg.gram.remove_neg,
                 remove_only_teacher_neg=cfg.gram.remove_only_teacher_neg,
+            )
+            # gram.tokens_used: all | masked | unmasked (reference
+            # ssl_meta_arch.py:221-222; masked variants force token level)
+            tokens_used = str(cfg.gram.get("tokens_used", "all") or "all")
+            tok_mask = None
+            if tokens_used == "masked":
+                tok_mask = batch["masks"]
+            elif tokens_used == "unmasked":
+                tok_mask = ~batch["masks"]
+            elif tokens_used != "all":
+                raise ValueError(f"unknown gram.tokens_used {tokens_used!r}")
+            g_loss = gram_loss(
+                student_global["patch_pre_head"], gram_feats,
+                img_level=(cfg.gram.img_level and tok_mask is None),
+                token_mask=tok_mask,
+                **gram_kw,
             )
             loss_dict["gram_loss"] = g_loss
             loss_dict["gram_loss_weight"] = jnp.asarray(gram_w, jnp.float32)
             total = total + gram_w * g_loss
+            if cfg.gram.get("compute_stats", False):
+                # stats-only masked/unmasked views (reference:543-556);
+                # reported, never added to the total
+                for name, m in (("masked", batch["masks"]),
+                                ("unmasked", ~batch["masks"])):
+                    loss_dict[f"stats_only/{name}_gram_loss"] = (
+                        jax.lax.stop_gradient(gram_loss(
+                            student_global["patch_pre_head"], gram_feats,
+                            img_level=False, token_mask=m, **gram_kw,
+                        ))
+                    )
 
         if "moe_aux_loss" in student_global:
             aux_w = float(cfg.student.get("moe_aux_loss_weight", 0.01) or 0.0)

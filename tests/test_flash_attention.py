@@ -82,16 +82,18 @@ def test_jit_and_vit_shapes(rng):
 def test_flash_block_caps_honored():
     """kernels.flash_block_q/kv cap the kernel block sizes (they were
     previously declared in the schema but never consumed)."""
-    from dinov3_tpu.ops.flash_attention import (
-        _block_sizes,
-        set_flash_block_caps,
-    )
+    from dinov3_tpu.ops.flash_attention import _block_sizes
 
-    try:
-        set_flash_block_caps(128, 256)
-        assert _block_sizes(1024) == (128, 256)
-        set_flash_block_caps(512, 512)
-        assert _block_sizes(1024) == (512, 512)
-        assert _block_sizes(1152) == (128, 128)  # 1152 = 9*128
-    finally:
-        set_flash_block_caps(512, 512)
+    assert _block_sizes(1024, 128, 256) == (128, 256)
+    assert _block_sizes(1024) == (512, 512)
+    assert _block_sizes(1152) == (128, 128)  # 1152 = 9*128
+
+    # and the caps thread from config to the attention module
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.models import backbone_kwargs_from_cfg
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, ["student.arch=vit_test",
+                              "kernels.flash_block_q=256"])
+    kw = backbone_kwargs_from_cfg(cfg)
+    assert kw["flash_block_q"] == 256 and kw["flash_block_kv"] == 512

@@ -245,3 +245,24 @@ def test_koleo_zero_rows_gradient_finite():
     x = jnp.zeros((8, 16))
     g = jax.grad(lambda v: koleo_loss(v))(x)
     assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))
+
+
+def test_gram_token_mask_matches_subset():
+    """tokens_used=masked via token_mask == dense gram on just the selected
+    rows (gram.tokens_used, reference ssl_meta_arch.py:221-222)."""
+    k = jax.random.key(0)
+    s = jax.random.normal(k, (2, 6, 8))
+    t = s + 0.05 * jax.random.normal(jax.random.fold_in(k, 1), (2, 6, 8))
+    mask = jnp.zeros((2, 6), bool).at[:, :3].set(True)
+    got = gram_loss(s, t, img_level=False, token_mask=mask)
+    # manual: only the first 3 tokens of each image enter the gram
+    sel_s = s[:, :3].reshape(-1, 8)
+    sel_t = t[:, :3].reshape(-1, 8)
+    import numpy as _np
+
+    def gram(x):
+        xn = _np.asarray(x) / _np.linalg.norm(
+            _np.asarray(x), axis=-1, keepdims=True)
+        return xn @ xn.T
+    ref = ((gram(sel_s) - gram(sel_t)) ** 2).mean()
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
