@@ -74,14 +74,32 @@ def make_synthetic_batch(
 
 
 class SyntheticDataset:
-    """Iterator over synthetic batches (infinite)."""
+    """Iterator over synthetic batches (infinite).
+
+    ``train.cache_dataset`` (reference config key) pregenerates a small
+    pool of batches and cycles it, removing per-step host generation cost
+    — useful when the host CPU or host->device link is the bottleneck.
+    """
+
+    CACHE_POOL = 8
 
     def __init__(self, cfg: ConfigNode, batch_size: int, seed: int = 0):
         self.cfg = cfg
         self.batch_size = batch_size
         self.seed = seed
+        self.cache = bool(cfg.train.get("cache_dataset", False))
 
     def __iter__(self):
+        if self.cache:
+            pool = [
+                make_synthetic_batch(self.cfg, self.batch_size,
+                                     seed=self.seed + i)
+                for i in range(self.CACHE_POOL)
+            ]
+            i = 0
+            while True:
+                yield pool[i % len(pool)]
+                i += 1
         i = 0
         while True:
             yield make_synthetic_batch(self.cfg, self.batch_size,

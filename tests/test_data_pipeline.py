@@ -384,3 +384,29 @@ def test_web_shards_val_split_requires_own_shards(tmp_path):
     val = WebShards(root=str(tmp_path), split="VAL")
     assert len(val) == 2
     assert sorted(val.get_targets().tolist()) == [100, 101]
+
+
+def test_synthetic_cache_dataset_cycles():
+    """train.cache_dataset pregenerates a pool and cycles it."""
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.data import SyntheticDataset
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        "student.patch_size=4", "crops.global_crops_size=16",
+        "crops.local_crops_size=8", "crops.local_crops_number=2",
+        "train.cache_dataset=true",
+    ])
+    it = iter(SyntheticDataset(cfg, 2, seed=0))
+    pool = SyntheticDataset.CACHE_POOL
+    first = next(it)
+    for _ in range(pool - 1):
+        next(it)
+    again = next(it)  # wrapped around
+    np.testing.assert_array_equal(first["global_crops"], again["global_crops"])
+
+    # default (no cache): consecutive batches differ
+    apply_dot_overrides(cfg, ["train.cache_dataset=false"])
+    it = iter(SyntheticDataset(cfg, 2, seed=0))
+    a, b = next(it), next(it)
+    assert not np.array_equal(a["global_crops"], b["global_crops"])
