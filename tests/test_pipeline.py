@@ -154,3 +154,20 @@ def test_pipelined_train_step(eight_devices):
         state, dbatch, setup.scalars(1), jax.random.key(0)
     )
     assert np.isfinite(float(metrics2["total_loss"]))
+
+
+def test_pipeline_composes_with_ring_attention(eight_devices):
+    """pipe=2 x seq=2 x data=2 in one program: GPipe stages whose attention
+    runs ring attention over the seq axis (the pipeline's UNCONSTRAINED
+    buffer dims must not force the token dim replicated)."""
+    cfg = _cfg(["parallel.data=2", "parallel.pipe=2", "parallel.seq=2"])
+    B = 8
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, B, seed=0).items()}
+    setup = build_train_setup(cfg, batch, devices=eight_devices)
+    assert setup.mesh.shape["pipe"] == 2 and setup.mesh.shape["seq"] == 2
+    dbatch = put_batch(batch, setup.batch_shardings)
+    state, metrics = setup.step_fn(
+        setup.state, dbatch, setup.scalars(0), jax.random.key(0)
+    )
+    assert np.isfinite(float(metrics["total_loss"]))
