@@ -117,6 +117,14 @@ def apply_dot_overrides(cfg: ConfigNode, overrides: Iterable[str]) -> ConfigNode
                         f"{'.'.join(keys[:depth + 1])!r} (prefix with '+' "
                         "to add new keys)"
                     )
+                if nxt is not None and not allow_new:
+                    # optim.lr.x=1 must not silently clobber the scalar
+                    # optim.lr into a section
+                    raise KeyError(
+                        f"override {item!r}: "
+                        f"{'.'.join(keys[:depth + 1])!r} is a value, not a "
+                        "section (prefix with '+' to replace it with one)"
+                    )
                 nxt = ConfigNode()
                 node[k] = nxt
             elif not isinstance(nxt, ConfigNode):
@@ -132,6 +140,14 @@ def apply_dot_overrides(cfg: ConfigNode, overrides: Iterable[str]) -> ConfigNode
                 raise KeyError(
                     f"override {item!r}: unknown key {path!r} (prefix "
                     "with '+' to add new keys)"
+                )
+            if (not allow_new and isinstance(node.get(leaf), dict)
+                    and not isinstance(value, dict)):
+                # the symmetric clobber: optim=5 must not silently wipe
+                # the whole optim section
+                raise KeyError(
+                    f"override {item!r}: {path!r} is a section, not a "
+                    "value (prefix with '+' to replace it)"
                 )
             node[leaf] = value
     return cfg

@@ -138,16 +138,25 @@ class MetricLogger:
         self.output_file = output_file
         self._tb = None
         if tensorboard_dir:
+            # torch-free writer first; torch.utils.tensorboard is only a
+            # fallback so the flag works on hosts without the (optional)
+            # torch dependency.
             try:
-                from torch.utils.tensorboard import SummaryWriter
-
-                self._tb = SummaryWriter(log_dir=tensorboard_dir)
+                from tensorboardX import SummaryWriter
             except ImportError:
+                try:
+                    from torch.utils.tensorboard import SummaryWriter
+                except ImportError:
+                    SummaryWriter = None
+            if SummaryWriter is None:
                 logger.warning(
-                    "tensorboard_dir=%s set but tensorboard is not "
-                    "importable; falling back to JSON-lines only",
-                    tensorboard_dir,
+                    "tensorboard_dir=%s set but neither tensorboardX nor "
+                    "torch.utils.tensorboard is importable (both are "
+                    "optional dependencies); falling back to JSON-lines "
+                    "only", tensorboard_dir,
                 )
+            else:
+                self._tb = SummaryWriter(log_dir=tensorboard_dir)
 
     def update(self, **kwargs) -> None:
         for k, v in kwargs.items():

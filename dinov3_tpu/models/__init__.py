@@ -72,6 +72,12 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
         )
     if pr != "none":
         kw["remat"] = pr
+    kernels = cfg.get("kernels") or {}
+    kw["attn_impl"] = kernels.get("flash_attention", "auto")
+    kw["flash_block_q"] = int(kernels.get("flash_block_q", 512) or 512)
+    kw["flash_block_kv"] = int(kernels.get("flash_block_kv", 512) or 512)
+    parallel = cfg.get("parallel") or {}
+    kw["seq_parallel"] = int(parallel.get("seq", 1) or 1) > 1
     if kw["remat"] == "attn" and kw["seq_parallel"]:
         import logging
 
@@ -81,12 +87,6 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
             "(same for the pallas flash kernel at >=%d tokens)",
             1024,
         )
-    kernels = cfg.get("kernels") or {}
-    kw["attn_impl"] = kernels.get("flash_attention", "auto")
-    kw["flash_block_q"] = int(kernels.get("flash_block_q", 512) or 512)
-    kw["flash_block_kv"] = int(kernels.get("flash_block_kv", 512) or 512)
-    parallel = cfg.get("parallel") or {}
-    kw["seq_parallel"] = int(parallel.get("seq", 1) or 1) > 1
     kw["pipeline_stages"] = int(parallel.get("pipe", 1) or 1)
     kw["pipeline_microbatches"] = int(parallel.get("pipe_microbatches", 0) or 0)
     kw["scan_layers"] = bool(train.get("scan_layers", False))

@@ -118,3 +118,24 @@ def test_dot_overrides_reject_unknown_keys():
     # nested-but-existing sections still work, including null sections
     apply_dot_overrides(cfg, ["optim.lr=0.5"])
     assert cfg.optim.lr == 0.5
+
+
+def test_dot_overrides_reject_scalar_to_section():
+    """optim.lr.x=1 must not silently clobber the scalar optim.lr into a
+    section (losing the configured value)."""
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, ["optim.lr=0.5"])
+    with pytest.raises(KeyError, match="value, not a section"):
+        apply_dot_overrides(cfg, ["optim.lr.x=1"])
+    assert cfg.optim.lr == 0.5
+    # explicit opt-in with '+' still allows replacing it with a section
+    apply_dot_overrides(cfg, ["+optim.lr.x=1"])
+    assert cfg.optim.lr.x == 1
+    # ... and the symmetric direction: a scalar must not wipe a section
+    with pytest.raises(KeyError, match="section, not a value"):
+        apply_dot_overrides(cfg, ["optim=5"])
+    assert cfg.optim.lr.x == 1
+    apply_dot_overrides(cfg, ["+optim=5"])
+    assert cfg.optim == 5

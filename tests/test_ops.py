@@ -303,6 +303,23 @@ def test_fp8_flag_threads_from_config():
     assert not kw.get("fp8")
 
 
+def test_remat_attn_config_path():
+    """parallel.remat=attn must thread through backbone_kwargs_from_cfg
+    (regression: the seq-parallel warning read kw['seq_parallel'] before
+    assignment -> KeyError)."""
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.models import backbone_kwargs_from_cfg
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, ["student.arch=vit_test", "parallel.remat=attn"])
+    kw = backbone_kwargs_from_cfg(cfg)
+    assert kw["remat"] == "attn"
+    # and with seq parallelism on (the warning path itself)
+    apply_dot_overrides(cfg, ["parallel.seq=2"])
+    kw = backbone_kwargs_from_cfg(cfg)
+    assert kw["remat"] == "attn" and kw["seq_parallel"]
+
+
 def test_remat_attn_matches_none():
     """remat='attn' (recompute softmax state in backward) must be exact —
     same outputs and same grads as no remat."""
