@@ -29,22 +29,55 @@ class Checkpointer:
         max_to_keep: int = 3,
         keep_every: int | None = None,
         async_save: bool = True,
+        process_group: tuple[int, ...] | None = None,
+        sync_prefix: str | None = None,
     ):
+        """``process_group``: restrict orbax's cross-host barriers to these
+        process indices (multidistillation subgroups checkpoint disjoint
+        students concurrently; a global barrier would interleave/deadlock
+        across groups). ``sync_prefix`` keys the group's barriers apart."""
         import os
 
         directory = os.path.abspath(directory)
+        extra = {}
+        create = True
+        if process_group is not None:
+            extra["multiprocessing_options"] = ocp.options.MultiprocessingOptions(
+                primary_host=min(process_group),
+                active_processes=set(process_group),
+                barrier_sync_key_prefix=sync_prefix,
+            )
+            # orbax refuses create=True with active_processes
+            os.makedirs(directory, exist_ok=True)
+            create = False
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             keep_period=keep_every,
             enable_async_checkpointing=async_save,
-            create=True,
+            create=create,
+            **extra,
         )
         self.manager = ocp.CheckpointManager(directory, options=options)
+        # a one-host subgroup in a multi-host runtime produces fully-
+        # addressable arrays, which orbax's jax.Array handler refuses
+        # ("host local") even with active_processes scoped; numpy leaves
+        # take the numpy handler and land in the same zarr layout
+        self._numpy_save = (
+            process_group is not None and len(process_group) == 1
+            and jax.process_count() > 1
+        )
 
     # -------- save --------
 
     def save(self, step: int, state: TrainState) -> bool:
         """Async save; returns True if a save was started."""
+        if self._numpy_save:
+            import numpy as np
+
+            state = jax.tree.map(
+                lambda v: np.asarray(v) if isinstance(v, jax.Array) else v,
+                state,
+            )
         saved = self.manager.save(
             step, args=ocp.args.Composite(state=ocp.args.StandardSave(state))
         )

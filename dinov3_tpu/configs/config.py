@@ -177,23 +177,26 @@ def load_config(
     return cfg
 
 
-def data_parallel_world(cfg: ConfigNode) -> int:
+def data_parallel_world(cfg: ConfigNode, n_devices: int | None = None) -> int:
     """Number of devices holding independent batch shards.
 
     Model-parallel axes (tensor, seq, pipe, expert) replicate the batch,
-    so they are divided out of the device count.
+    so they are divided out of the device count. ``n_devices`` overrides
+    the global device count (multidistillation subgroup meshes).
     """
-    import jax
+    if n_devices is None:
+        import jax
 
+        n_devices = jax.device_count()
     replicas = 1
     par = cfg.get("parallel") or {}
     for axis in ("tensor", "seq", "pipe", "expert"):
         replicas *= int(par.get(axis, 1) or 1)
-    return max(1, jax.device_count() // replicas)
+    return max(1, n_devices // replicas)
 
 
-def global_batch_size(cfg: ConfigNode) -> int:
-    return cfg.train.batch_size_per_device * data_parallel_world(cfg)
+def global_batch_size(cfg: ConfigNode, n_devices: int | None = None) -> int:
+    return cfg.train.batch_size_per_device * data_parallel_world(cfg, n_devices)
 
 
 def apply_scaling_rules_to_cfg(cfg: ConfigNode) -> ConfigNode:

@@ -18,6 +18,47 @@ from typing import Iterator, Sequence
 import numpy as np
 
 
+def multires_subconfigs(cfg):
+    """One (sub_cfg, ratio) per (global, local, gram) crop-size triple.
+
+    Returns ``None`` when the recipe is single-resolution (scalar crop
+    sizes). Shared by the real data pipeline and the synthetic backend so
+    both route the high-res-adapt recipes identically."""
+    import copy
+
+    crops = cfg.crops
+    g_sizes = crops.global_crops_size
+    if not isinstance(g_sizes, (list, tuple)):
+        return None
+    l_sizes = crops.local_crops_size
+    gram_sizes = crops.get("gram_teacher_crops_size") or [None] * len(g_sizes)
+    ratios = crops.get("global_local_crop_pairs_ratios")
+    if not isinstance(l_sizes, (list, tuple)) or len(l_sizes) != len(g_sizes):
+        raise ValueError("global/local crop size lists must have equal length")
+    if not isinstance(ratios, (list, tuple)):
+        ratios = [1.0] * len(g_sizes)
+    out = []
+    for g, l, gram, r in zip(g_sizes, l_sizes, gram_sizes, ratios):
+        sub = copy.deepcopy(cfg)
+        sub.crops.global_crops_size = int(g)
+        sub.crops.local_crops_size = int(l)
+        sub.crops.gram_teacher_crops_size = int(gram) if gram else None
+        out.append((sub, float(r)))
+    return out
+
+
+def split_advance(seed: int, ratios: Sequence[float], n_batches: int):
+    """Replay the combiner's deterministic choice stream for ``n_batches``
+    draws: how many batches each sub-loader contributed (exact resume)."""
+    p = np.asarray(ratios, np.float64) / float(sum(ratios))
+    if not n_batches:
+        return np.zeros(len(ratios), np.int64)
+    draws = np.random.default_rng(seed).choice(
+        len(ratios), size=n_batches, p=p
+    )
+    return np.bincount(draws, minlength=len(ratios))
+
+
 class CombineDataLoader:
     """Draw batches from ``loaders`` with probabilities ``ratios``."""
 

@@ -104,33 +104,41 @@ def make_multires_train_pipeline(
     global_batch_size: int,
     rank: int = 0,
     world_size: int = 1,
+    sampler_advance_batches: int = 0,
 ) -> Iterator[dict]:
     """Multi-resolution variant: one pipeline per (global, local, gram)
     crop-size triple, combined by ``crops.crop_size_ratios``
     (reference train.py:718-769, with the missing combiner implemented in
-    data/multires.py)."""
-    from dinov3_tpu.data.multires import CombineDataLoader
+    data/multires.py).
 
-    crops = cfg.crops
-    g_sizes = crops.global_crops_size
-    if not isinstance(g_sizes, (list, tuple)):
-        return make_train_pipeline(cfg, global_batch_size, rank, world_size)
-    l_sizes = crops.local_crops_size
-    gram_sizes = crops.get("gram_teacher_crops_size") or [None] * len(g_sizes)
-    ratios = crops.get("global_local_crop_pairs_ratios")
-    if not isinstance(l_sizes, (list, tuple)) or len(l_sizes) != len(g_sizes):
-        raise ValueError("global/local crop size lists must have equal length")
-    import copy
+    ``sampler_advance_batches`` resumes the combined stream exactly: the
+    combiner's deterministic choice stream is replayed to count how many
+    batches each resolution contributed in the skipped prefix, and each
+    sub-pipeline's sampler advances by that many local samples.
+    """
+    from dinov3_tpu.data.multires import (
+        CombineDataLoader,
+        multires_subconfigs,
+        split_advance,
+    )
 
-    loaders = []
-    for g, l, gram in zip(g_sizes, l_sizes, gram_sizes):
-        sub = copy.deepcopy(cfg)
-        sub.crops.global_crops_size = int(g)
-        sub.crops.local_crops_size = int(l)
-        sub.crops.gram_teacher_crops_size = int(gram) if gram else None
-        loaders.append(
-            make_train_pipeline(sub, global_batch_size, rank, world_size)
+    local_batch = global_batch_size // max(1, world_size)
+    subs = multires_subconfigs(cfg)
+    if subs is None:
+        return make_train_pipeline(
+            cfg, global_batch_size, rank, world_size,
+            sampler_advance=sampler_advance_batches * local_batch,
         )
-    if not isinstance(ratios, (list, tuple)):
-        ratios = [1.0] * len(loaders)
-    return iter(CombineDataLoader(loaders, list(ratios), seed=cfg.train.seed))
+    ratios = [r for _, r in subs]
+    counts = split_advance(cfg.train.seed, ratios, sampler_advance_batches)
+    loaders = [
+        make_train_pipeline(
+            sub, global_batch_size, rank, world_size,
+            sampler_advance=int(counts[j]) * local_batch,
+        )
+        for j, (sub, _) in enumerate(subs)
+    ]
+    combined = CombineDataLoader(loaders, ratios, seed=cfg.train.seed)
+    if sampler_advance_batches:
+        combined.advance(sampler_advance_batches)
+    return iter(combined)

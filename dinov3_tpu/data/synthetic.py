@@ -39,7 +39,7 @@ def batch_spec(cfg: ConfigNode, batch_size: int) -> dict:
 
 
 def make_synthetic_batch(
-    cfg: ConfigNode, batch_size: int, seed: int = 0
+    cfg: ConfigNode, batch_size: int, seed=0
 ) -> dict:
     rng = np.random.default_rng(seed)
     spec = batch_spec(cfg, batch_size)
@@ -83,25 +83,32 @@ class SyntheticDataset:
 
     CACHE_POOL = 8
 
-    def __init__(self, cfg: ConfigNode, batch_size: int, seed: int = 0):
+    def __init__(self, cfg: ConfigNode, batch_size: int, seed: int = 0,
+                 rank: int = 0, world_size: int = 1, advance: int = 0):
+        """``batch_size`` is the per-host (local) batch; hosts draw
+        disjoint streams via the (seed, rank, ordinal) RNG key, and
+        ``advance`` skips the first n batches (data-stream resume)."""
         self.cfg = cfg
         self.batch_size = batch_size
         self.seed = seed
+        self.rank = rank
+        self.world_size = world_size
+        self.advance = advance
         self.cache = bool(cfg.train.get("cache_dataset", False))
+
+    def _batch(self, i: int) -> dict:
+        return make_synthetic_batch(
+            self.cfg, self.batch_size, seed=(self.seed, self.rank, i)
+        )
 
     def __iter__(self):
         if self.cache:
-            pool = [
-                make_synthetic_batch(self.cfg, self.batch_size,
-                                     seed=self.seed + i)
-                for i in range(self.CACHE_POOL)
-            ]
-            i = 0
+            pool = [self._batch(i) for i in range(self.CACHE_POOL)]
+            i = self.advance
             while True:
                 yield pool[i % len(pool)]
                 i += 1
-        i = 0
+        i = self.advance
         while True:
-            yield make_synthetic_batch(self.cfg, self.batch_size,
-                                       seed=self.seed + i)
+            yield self._batch(i)
             i += 1

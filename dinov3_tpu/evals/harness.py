@@ -29,7 +29,8 @@ from dinov3_tpu.evals.linear import linear_probe_eval
 logger = logging.getLogger("dinov3")
 
 
-def _loader(dataset_str, transform, batch_size, num_workers, seed, max_samples):
+def _loader(dataset_str, transform, batch_size, num_workers, seed,
+            max_samples, rank=0, world_size=1):
     def wrap(samples):
         return collate_eval(
             [{"image": img, "label": t} for img, t in samples]
@@ -40,9 +41,13 @@ def _loader(dataset_str, transform, batch_size, num_workers, seed, max_samples):
     loader = make_data_loader(
         ds, batch_size=batch_size, collate_fn=wrap,
         num_workers=num_workers, shuffle=True, seed=seed,
+        rank=rank, world_size=world_size,
         sampler_type=SamplerType.EPOCH, drop_last=True,
     )
-    max_batches = max(1, min(n, max_samples) // batch_size)
+    local_n = n // max(1, world_size)
+    if max_samples is not None:
+        local_n = min(local_n, max_samples // max(1, world_size))
+    max_batches = max(1, local_n // batch_size)
     return loader, max_batches
 
 
@@ -55,13 +60,21 @@ def do_eval(
     val_dataset_str: str | None = None,
     n_classes: int = 1000,
     batch_size: int = 64,
-    max_train_samples: int = 10_000,
-    max_val_samples: int = 2_000,
+    max_train_samples: int | None = 10_000,
+    max_val_samples: int | None = 2_000,
     knn_k: int = 10,
     probe_epochs: int = 10,
+    protocol: bool = False,
 ) -> dict:
     """Returns {"knn_top1": .., "linear_top1": ..} for the given backbone
-    params (normally the EMA teacher's)."""
+    params (normally the EMA teacher's).
+
+    Defaults are the cheap in-training signal (capped samples, one probe).
+    ``protocol=True`` is the certification mode (``python -m
+    dinov3_tpu.evals``): pass ``max_*_samples=None`` for the FULL dataset,
+    features extracted per host shard and allgathered, probes swept over
+    the DINOv2 lr grid, k-NN at k=10 and 20.
+    """
     ev = cfg.get("evaluation") or {}
     # same rooting rule as the train pipeline, so the eval sees the same
     # dataset the trainer does (data.root applied, backend=folder mapped)
@@ -71,18 +84,25 @@ def do_eval(
     val_raw = val_dataset_str or ev.get("val_dataset_path")
     val_str = resolve_dataset_str(cfg, val_raw) if val_raw else train_str
     size = cfg.crops.global_crops_size
+    if isinstance(size, (list, tuple)):
+        size = int(size[0])
     num_workers = cfg.train.get("num_workers", 8)
+    import jax
+
+    rank, world = jax.process_index(), jax.process_count()
 
     train_loader, train_batches = _loader(
         train_str,
         make_classification_train_transform(crop_size=size),
         batch_size, num_workers, cfg.train.seed, max_train_samples,
+        rank=rank, world_size=world,
     )
     val_loader, val_batches = _loader(
         val_str,
         make_classification_eval_transform(
             resize_size=int(size * 256 / 224), crop_size=size),
         batch_size, num_workers, cfg.train.seed + 1, max_val_samples,
+        rank=rank, world_size=world,
     )
 
     train_feats, train_labels = extract_features(
@@ -93,19 +113,47 @@ def do_eval(
         model, {"params": teacher_backbone_params}, iter(val_loader),
         max_batches=val_batches,
     )
+    if world > 1:
+        # each host extracted its disjoint shard; the probe/knn need the
+        # full feature matrix (features are tiny next to the images)
+        from jax.experimental import multihost_utils
+
+        gather = multihost_utils.process_allgather
+        train_feats = np.concatenate(gather(train_feats))
+        train_labels = np.concatenate(gather(train_labels))
+        val_feats = np.concatenate(gather(val_feats))
+        val_labels = np.concatenate(gather(val_labels))
     n_classes = int(
         max(n_classes, train_labels.max() + 1, val_labels.max() + 1)
     )
-    results = {
-        "knn_top1": knn_eval(
-            train_feats, train_labels, val_feats, val_labels,
-            n_classes, k=knn_k,
-        ),
-        "linear_top1": linear_probe_eval(
+    if protocol:
+        from dinov3_tpu.evals.knn import knn_eval_multi
+        from dinov3_tpu.evals.linear import linear_probe_sweep
+
+        best, grid = linear_probe_sweep(
             train_feats, train_labels, val_feats, val_labels,
             n_classes, epochs=probe_epochs,
-        ),
-    }
+        )
+        results = {
+            **knn_eval_multi(train_feats, train_labels, val_feats,
+                             val_labels, n_classes),
+            "linear_top1": best,
+            "linear_sweep": grid,
+        }
+        results["knn_top1"] = max(
+            v for k, v in results.items() if k.startswith("knn")
+        )
+    else:
+        results = {
+            "knn_top1": knn_eval(
+                train_feats, train_labels, val_feats, val_labels,
+                n_classes, k=knn_k,
+            ),
+            "linear_top1": linear_probe_eval(
+                train_feats, train_labels, val_feats, val_labels,
+                n_classes, epochs=probe_epochs,
+            ),
+        }
     logger.info(
         "eval: knn_top1=%.4f linear_top1=%.4f (%d train / %d val feats)",
         results["knn_top1"], results["linear_top1"],

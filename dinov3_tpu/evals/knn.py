@@ -59,3 +59,18 @@ def knn_eval(
         train_feats, train_labels, test_feats, n_classes, k, temperature
     )
     return float((preds == np.asarray(test_labels)).mean())
+
+
+def knn_eval_multi(
+    train_feats, train_labels, test_feats, test_labels,
+    n_classes: int, ks=(10, 20), temperature: float = 0.07,
+) -> dict:
+    """{"knn10_top1": .., "knn20_top1": ..} — the DINO protocol reports
+    both; the headline 82.2% is the best-k number."""
+    return {
+        f"knn{k}_top1": knn_eval(
+            train_feats, train_labels, test_feats, test_labels,
+            n_classes, k=k, temperature=temperature,
+        )
+        for k in ks
+    }

@@ -80,3 +80,101 @@ def linear_probe_eval(
     logits = jnp.asarray(test_feats, jnp.float32) @ params["w"] + params["b"]
     preds = np.asarray(jnp.argmax(logits, axis=-1))
     return float((preds == np.asarray(test_labels)).mean())
+
+
+# DINOv2-protocol sweep grid (the published linear-probe numbers pick the
+# best classifier from a grid of learning rates; weight decay stays 0 in
+# the protocol but the grid accepts any)
+DEFAULT_PROBE_LRS = (1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1)
+DEFAULT_PROBE_WDS = (0.0,)
+
+
+def linear_probe_sweep(
+    train_feats: np.ndarray,
+    train_labels: np.ndarray,
+    test_feats: np.ndarray,
+    test_labels: np.ndarray,
+    n_classes: int,
+    lrs=DEFAULT_PROBE_LRS,
+    wds=DEFAULT_PROBE_WDS,
+    epochs: int = 10,
+    batch_size: int = 256,
+    seed: int = 0,
+) -> tuple[float, dict]:
+    """Train the full lr x wd grid of probes JOINTLY (one vmapped program —
+    every probe shares the feature matmuls, so the sweep costs barely more
+    than one probe on the MXU) and return (best_acc, per-combo accs).
+
+    (protocol: the reference's 83.3% linear number comes from Meta's
+    grid-swept probe — vitl_im1k_lin834.yaml:1-4; the reference itself had
+    no eval harness at all, train/train.py:315-316.)
+    """
+    x = jnp.asarray(train_feats, jnp.float32)
+    y = jnp.asarray(train_labels, jnp.int32)
+    n, d = x.shape
+    batch_size = min(batch_size, n)
+    steps_per_epoch = max(1, n // batch_size)
+    total_steps = epochs * steps_per_epoch
+    combos = [(lr, wd) for lr in lrs for wd in wds]
+    lr_arr = jnp.asarray([c[0] for c in combos], jnp.float32)
+    wd_arr = jnp.asarray([c[1] for c in combos], jnp.float32)
+    C = len(combos)
+
+    w0 = jnp.zeros((C, d, n_classes), jnp.float32)
+    b0 = jnp.zeros((C, n_classes), jnp.float32)
+
+    def loss_fn(w, b, xb, yb):
+        logits = xb @ w + b
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb
+        ).mean()
+
+    @jax.jit
+    def train_all(w, b, rng):
+        # momentum SGD + cosine decay, hyperparams vectorized over combos
+        mw = jnp.zeros_like(w)
+        mb = jnp.zeros_like(b)
+        sched = optax.cosine_decay_schedule(1.0, total_steps)
+
+        def epoch_body(carry, erng):
+            w, b, mw, mb, t = carry
+            order = jax.random.permutation(erng, n)
+
+            def step_body(carry, i):
+                w, b, mw, mb, t = carry
+                idx = jax.lax.dynamic_slice_in_dim(
+                    order, i * batch_size, batch_size
+                )
+                xb, yb = x[idx], y[idx]
+                gw, gb = jax.vmap(
+                    jax.grad(loss_fn, argnums=(0, 1)),
+                    in_axes=(0, 0, None, None),
+                )(w, b, xb, yb)
+                gw = gw + wd_arr[:, None, None] * w
+                lr_t = lr_arr * sched(t)
+                mw = 0.9 * mw + gw
+                mb = 0.9 * mb + gb
+                w = w - lr_t[:, None, None] * mw
+                b = b - lr_t[:, None] * mb
+                return (w, b, mw, mb, t + 1), None
+
+            carry, _ = jax.lax.scan(
+                step_body, (w, b, mw, mb, t), jnp.arange(steps_per_epoch)
+            )
+            return carry, None
+
+        (w, b, *_), _ = jax.lax.scan(
+            epoch_body, (w, b, mw, mb, jnp.zeros((), jnp.int32)),
+            jax.random.split(rng, epochs),
+        )
+        return w, b
+
+    w, b = train_all(w0, b0, jax.random.key(seed))
+    te = jnp.asarray(test_feats, jnp.float32)
+    ty = np.asarray(test_labels)
+    accs = {}
+    for ci, (lr, wd) in enumerate(combos):
+        preds = np.asarray(jnp.argmax(te @ w[ci] + b[ci], axis=-1))
+        accs[f"lr={lr:g},wd={wd:g}"] = float((preds == ty).mean())
+    best = max(accs.values())
+    return best, accs

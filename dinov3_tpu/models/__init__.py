@@ -113,6 +113,7 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
     kw["dtype"] = policy.compute_dtype
     kw["param_dtype"] = policy.param_dtype
     kw["reduce_dtype"] = policy.reduce_dtype
+    kw["probs_dtype"] = policy.probs_dtype
     return kw
 
 

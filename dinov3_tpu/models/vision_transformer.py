@@ -108,6 +108,7 @@ class DinoVisionTransformer(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
+    probs_dtype: Any = None  # attention-probability storage (None = fp32)
 
     @property
     def head_dim(self) -> int:
@@ -197,7 +198,7 @@ class DinoVisionTransformer(nn.Module):
             seq_parallel=self.seq_parallel, fp8=self.fp8,
             moe_num_experts=self.moe_num_experts, moe_top_k=self.moe_top_k,
             dtype=self.dtype, param_dtype=self.param_dtype,
-            reduce_dtype=self.reduce_dtype,
+            reduce_dtype=self.reduce_dtype, probs_dtype=self.probs_dtype,
         )
 
     def _run_blocks(self, x, rope, deterministic, collect: Sequence[int] = ()):
@@ -420,13 +421,15 @@ vit_huge2 = _ctor(1280, 32, 20, 4.0)
 vit_giant2 = _ctor(1536, 40, 24, 4.0)
 vit_7b = _ctor(4096, 40, 32, 3.0)
 # tiny configs for tests/smoke runs (not in the reference ladder);
-# vit_test_big is a distinct-width "teacher" for distillation tests
+# vit_test_big is a distinct-width "teacher" for distillation tests,
+# vit_test4 a 4-block stack for 4-stage pipeline validation
 vit_test = _ctor(64, 2, 2, 2.0)
 vit_test_big = _ctor(96, 3, 2, 2.0)
+vit_test4 = _ctor(64, 4, 2, 2.0)
 
 ARCHS = {
     "vit_small": vit_small, "vit_base": vit_base, "vit_large": vit_large,
     "vit_so400m": vit_so400m, "vit_huge2": vit_huge2,
     "vit_giant2": vit_giant2, "vit_7b": vit_7b, "vit_test": vit_test,
-    "vit_test_big": vit_test_big,
+    "vit_test_big": vit_test_big, "vit_test4": vit_test4,
 }

@@ -32,14 +32,52 @@ from dinov3_tpu.ops.common import (
 from dinov3_tpu.ops.rope import rope_apply_full, rope_apply_with_prefix
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _softmax_lowp(logits, out_dtype):
+    """Softmax with fp32 statistics but low-precision output AND residual.
+
+    Autodiff of a plain ``softmax(logits).astype(bf16)`` saves the fp32
+    probabilities for the backward — at ViT-L's 224px global crops that is
+    a [16, 16, 201, 201] fp32 array per layer whose save/transpose copies
+    showed up as ~12 ms/step of pure `copy-done` traffic in the round-2
+    profile. Storing the residual in ``out_dtype`` (bf16) halves that
+    traffic; the backward (dL = p * (g - sum(g*p))) accumulates in fp32.
+    """
+    return jax.nn.softmax(logits, axis=-1).astype(out_dtype)
+
+
+def _softmax_lowp_fwd(logits, out_dtype):
+    p = _softmax_lowp(logits, out_dtype)
+    return p, p
+
+
+def _softmax_lowp_bwd(out_dtype, p, g):
+    pf = p.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    s = jnp.sum(gf * pf, axis=-1, keepdims=True)
+    return (pf * (gf - s),)
+
+
+_softmax_lowp.defvjp(_softmax_lowp_fwd, _softmax_lowp_bwd)
+
+
 def xla_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     reduce_dtype=jnp.float32,
     causal: bool = False,
+    probs_dtype=None,
 ) -> jnp.ndarray:
-    """Unfused attention: [B, N, h, d] inputs, softmax in reduce_dtype."""
+    """Unfused attention: [B, N, h, d] inputs, softmax in reduce_dtype.
+
+    ``probs_dtype``: storage dtype of the probabilities (fp32 statistics
+    either way). bf16 halves the [B, h, N, N] HBM traffic — the recipe
+    default via ``compute_precision.probs_dtype`` — while ``None`` keeps
+    full-precision residuals (module default; bitwise-stable tests)."""
     d = q.shape[-1]
     scale = d ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -50,9 +88,12 @@ def xla_attention(
         row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, N, N), 2)
         col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, N, N), 3)
         logits = jnp.where(col <= row, logits, jnp.asarray(-jnp.inf, logits.dtype))
-    probs = jax.nn.softmax(logits, axis=-1)
+    if probs_dtype is not None and probs_dtype != logits.dtype:
+        probs = _softmax_lowp(logits, probs_dtype)
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
     # named for the "attn" remat policy (ops/block.py remat_block_cls):
-    # the [B, h, N, N] fp32 softmax state dominates saved activations at
+    # the [B, h, N, N] softmax state dominates saved activations at
     # long N; recomputing it in the backward trades cheap FLOPs for HBM
     from jax.ad_checkpoint import checkpoint_name
 
@@ -82,6 +123,7 @@ def dispatch_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     impl: str = "auto", reduce_dtype=jnp.float32,
     flash_block_q: int = 512, flash_block_kv: int = 512,
+    probs_dtype=None,
 ) -> jnp.ndarray:
     if impl == "auto":
         impl = (
@@ -94,7 +136,7 @@ def dispatch_attention(
             else "xla"
         )
     if impl in ("xla", "reference"):
-        return xla_attention(q, k, v, reduce_dtype)
+        return xla_attention(q, k, v, reduce_dtype, probs_dtype=probs_dtype)
     if impl == "pallas":
         from dinov3_tpu.ops.flash_attention import flash_attention
 
@@ -119,6 +161,7 @@ class SelfAttention(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
+    probs_dtype: Any = None  # probability storage; None = reduce_dtype
 
     @nn.compact
     def __call__(
@@ -153,8 +196,12 @@ class SelfAttention(nn.Module):
                 qkv_b = qkv_b * mask
             qkv = qkv + qkv_b.astype(self.dtype)
 
-        qkv = qkv.reshape(B, N, 3, h, d)
-        q, k, v = jnp.moveaxis(qkv, 2, 0)  # each [B, N, h, d]
+        # contiguous last-dim thirds (same column order as
+        # reshape(B,N,3,h,d) + moveaxis, which forced a full strided copy
+        # of qkv — round-2 profile: ~6 ms/step on the moveaxis alone)
+        q = qkv[..., : self.dim].reshape(B, N, h, d)
+        k = qkv[..., self.dim: 2 * self.dim].reshape(B, N, h, d)
+        v = qkv[..., 2 * self.dim:].reshape(B, N, h, d)
         if rope is not None:
             sin, cos = rope
             if sin.shape[-2] == N:
@@ -169,7 +216,8 @@ class SelfAttention(nn.Module):
         if self.causal:
             # causal runs the dense path (ViT's SSL path never uses it;
             # reference kept a CausalSelfAttention for generative probes)
-            out = xla_attention(q, k, v, self.reduce_dtype, causal=True)
+            out = xla_attention(q, k, v, self.reduce_dtype, causal=True,
+                                probs_dtype=self.probs_dtype)
         if out is None and self.seq_parallel:
             from dinov3_tpu.parallel.context import get_current_mesh
 
@@ -184,6 +232,7 @@ class SelfAttention(nn.Module):
                 q, k, v, self.attn_impl, self.reduce_dtype,
                 flash_block_q=self.flash_block_q,
                 flash_block_kv=self.flash_block_kv,
+                probs_dtype=self.probs_dtype,
             )
         out = constrain(out.reshape(B, N, self.dim), ("batch", None, "embed_act"))
 

@@ -42,6 +42,7 @@ class SelfAttentionBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
+    probs_dtype: Any = None
 
     @nn.compact
     def __call__(
@@ -66,6 +67,7 @@ class SelfAttentionBlock(nn.Module):
             flash_block_q=self.flash_block_q,
             flash_block_kv=self.flash_block_kv, dtype=self.dtype,
             param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
+            probs_dtype=self.probs_dtype,
             name="attn",
         )(make_norm_layer(self.norm_layer, name="norm1", **norm_kw)(x),
           rope=rope, deterministic=deterministic)

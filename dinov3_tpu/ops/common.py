@@ -44,13 +44,16 @@ class Policy:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     reduce_dtype: Any = jnp.float32
+    probs_dtype: Any = None  # attention-probability storage; None = reduce
 
     @classmethod
     def from_cfg(cls, precision_cfg) -> "Policy":
+        probs = precision_cfg.get("probs_dtype")
         return cls(
             param_dtype=canonical_dtype(precision_cfg.get("param_dtype", "fp32")),
             compute_dtype=canonical_dtype(precision_cfg.get("compute_dtype", "bf16")),
             reduce_dtype=canonical_dtype(precision_cfg.get("reduce_dtype", "fp32")),
+            probs_dtype=canonical_dtype(probs) if probs else None,
         )
 
 

@@ -21,10 +21,19 @@ def _dense_kwargs(fp8: bool) -> dict:
     return {"dot_general": fp8_dot_general} if fp8 else {}
 
 
+def exact_gelu(x):
+    """erf-based GELU — what torch ``nn.GELU()`` (and hence Meta's DINOv3)
+    computes; flax's ``nn.gelu`` defaults to the tanh approximation, which
+    diverges from the released weights' semantics by up to ~1e-3."""
+    import jax
+
+    return jax.nn.gelu(x, approximate=False)
+
+
 class Mlp(nn.Module):
     hidden_dim: int
     out_dim: int | None = None
-    act: Callable = nn.gelu
+    act: Callable = exact_gelu
     use_bias: bool = True
     dropout_rate: float = 0.0
     fp8: bool = False
@@ -116,7 +125,7 @@ class MoEFFN(nn.Module):
     num_experts: int = 8
     top_k: int = 2
     out_dim: int | None = None
-    act: Callable = nn.gelu
+    act: Callable = exact_gelu
     use_bias: bool = True
     fp8: bool = False  # accepted for make_ffn_layer symmetry; dense path only
     dtype: Any = jnp.bfloat16

@@ -1,0 +1,215 @@
+"""Fused LayerNorm as a Pallas TPU kernel with a custom VJP.
+
+Why: the round-1 profile of the ViT-L fused train step showed an ~18 ms
+fp32 elementwise tail dominated by layernorm statistics (of a 136 ms step)
+— XLA lowers the norm to separate reduce + apply fusions, reading the
+activation twice in fp32 per norm and more in the backward. This kernel
+reads the bf16 activation once, keeps mean/rstd in registers (fp32), and
+writes the normalized output once; the backward recomputes the statistics
+in-register instead of saving them, and accumulates dscale/dbias across
+row-blocks in VMEM.
+
+(reference: the PyTorch original uses torch.nn.LayerNorm = cuDNN fused
+kernels; the JAX port used plain ``nn.LayerNorm``/fp32 math with no fusion
+control — dinov3_jax/layers/rms_norm.py and nn.LayerNorm call sites.)
+
+Dispatch contract (``fused_layernorm``):
+- Pallas kernel on a TPU backend when the trailing dim is lane-aligned
+  (D % 128 == 0) and no multi-device mesh is active (an opaque custom call
+  inside a GSPMD program would force replication; multichip keeps XLA's
+  natively-partitionable lowering);
+- identical fp32 math through plain XLA ops otherwise (CPU test meshes,
+  odd widths) — same values, same gradients.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fine on CPU builds; guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_BLOCK_ROWS = 256
+
+
+def _vmem_spec(block_shape=None, index_map=None):
+    if _VMEM is None:  # pure-CPU jaxlib
+        return pl.BlockSpec(block_shape, index_map)
+    return pl.BlockSpec(block_shape, index_map, memory_space=_VMEM)
+
+
+def _stats(x, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    return xc, jax.lax.rsqrt(var + eps)
+
+
+def _mask_rows(t, i, br, n_valid):
+    """Zero rows beyond n_valid so garbage in the padded tail of the last
+    block cannot reach the stats or the dscale/dbias accumulators."""
+    row = i * br + jax.lax.broadcasted_iota(jnp.int32, t.shape, 0)
+    return jnp.where(row < n_valid, t, 0.0)
+
+
+def _fwd_kernel(x_ref, s_ref, b_ref, y_ref, *, eps, n_valid, br):
+    x = x_ref[...].astype(jnp.float32)
+    if n_valid % br:
+        x = _mask_rows(x, pl.program_id(0), br, n_valid)
+    xc, rstd = _stats(x, eps)
+    s = s_ref[...].astype(jnp.float32)
+    b = b_ref[...].astype(jnp.float32)
+    y_ref[...] = (xc * rstd * s + b).astype(y_ref.dtype)
+
+
+def _bwd_kernel(x_ref, s_ref, g_ref, dx_ref, ds_ref, db_ref,
+                *, eps, n_valid, br):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        ds_ref[...] = jnp.zeros_like(ds_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    if n_valid % br:
+        x = _mask_rows(x, i, br, n_valid)
+        g = _mask_rows(g, i, br, n_valid)
+    xc, rstd = _stats(x, eps)
+    xhat = xc * rstd
+    gs = g * s_ref[...].astype(jnp.float32)
+    c1 = jnp.mean(gs, axis=-1, keepdims=True)
+    c2 = jnp.mean(gs * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = (rstd * (gs - c1 - xhat * c2)).astype(dx_ref.dtype)
+    ds_ref[...] += jnp.sum(g * xhat, axis=0, keepdims=True)
+    db_ref[...] += jnp.sum(g, axis=0, keepdims=True)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln_2d(x, scale, bias, eps, interpret):
+    y, _ = _ln_2d_fwd(x, scale, bias, eps, interpret)
+    return y
+
+
+def _pallas_shapes(R: int):
+    br = min(_BLOCK_ROWS, _round_up(R, 16))
+    return br, pl.cdiv(R, br)
+
+
+def _ln_2d_fwd(x, scale, bias, eps, interpret):
+    R, D = x.shape
+    br, grid = _pallas_shapes(R)
+    y = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, n_valid=R, br=br),
+        grid=(grid,),
+        in_specs=[
+            _vmem_spec((br, D), lambda i: (i, 0)),
+            _vmem_spec((1, D), lambda i: (0, 0)),
+            _vmem_spec((1, D), lambda i: (0, 0)),
+        ],
+        out_specs=_vmem_spec((br, D), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, D), x.dtype),
+        interpret=interpret,
+    )(x, scale, bias)
+    return y, (x, scale)
+
+
+def _ln_2d_bwd(eps, interpret, res, g):
+    x, scale = res
+    R, D = x.shape
+    br, grid = _pallas_shapes(R)
+    dx, ds, db = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps, n_valid=R, br=br),
+        grid=(grid,),
+        in_specs=[
+            _vmem_spec((br, D), lambda i: (i, 0)),
+            _vmem_spec((1, D), lambda i: (0, 0)),
+            _vmem_spec((br, D), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((br, D), lambda i: (i, 0)),
+            _vmem_spec((1, D), lambda i: (0, 0)),
+            _vmem_spec((1, D), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, D), x.dtype),
+            jax.ShapeDtypeStruct((1, D), jnp.float32),
+            jax.ShapeDtypeStruct((1, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, scale, g)
+    return dx, ds.astype(scale.dtype), db.astype(scale.dtype)
+
+
+_ln_2d.defvjp(_ln_2d_fwd, _ln_2d_bwd)
+
+
+def _xla_layernorm(x, scale, bias, eps, reduce_dtype=jnp.float32):
+    xf = x.astype(reduce_dtype)
+    xc, rstd = _stats(xf, eps)
+    y = xc * rstd * scale.astype(reduce_dtype) + bias.astype(reduce_dtype)
+    return y.astype(x.dtype)
+
+
+def use_pallas_layernorm(D: int) -> bool:
+    """Opt-in (DINOV3_FUSED_LN=1): measured on v5e, the ViT-L train step is
+    *faster without* this kernel — XLA fuses the LN statistics directly
+    into the preceding matmul fusions (the round-2 profile's
+    convert_reduce_fusions run at ~86% MXU), and an opaque custom call
+    breaks those fusions and adds ~240 kernel launches per step (measured
+    53.7 vs 58.9 img/s). Kept for workloads where the norm is NOT adjacent
+    to a matmul."""
+    import os
+
+    if os.environ.get("DINOV3_FUSED_LN", "0") != "1":
+        return False
+    if jax.default_backend() != "tpu" or D % 128 != 0:
+        return False
+    from dinov3_tpu.parallel.context import get_current_mesh
+
+    mesh = get_current_mesh()
+    return mesh is None or mesh.size <= 1
+
+
+def fused_layernorm(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    eps: float = 1e-6,
+    interpret: bool | None = None,
+    force: bool | None = None,
+) -> jnp.ndarray:
+    """LayerNorm over the trailing dim: fp32 stats, output in ``x.dtype``.
+
+    ``force=True`` runs the Pallas kernel regardless of backend (tests use
+    it with ``interpret=True`` on CPU); ``force=False`` forces the XLA path.
+    """
+    D = x.shape[-1]
+    use = use_pallas_layernorm(D) if force is None else force
+    if not use:
+        return _xla_layernorm(x, scale.reshape(D), bias.reshape(D), eps)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = x.shape[:-1]
+    R = 1
+    for s in lead:
+        R *= s
+    y = _ln_2d(
+        x.reshape(R, D), scale.reshape(1, D), bias.reshape(1, D),
+        float(eps), interpret,
+    )
+    return y.reshape(*lead, D)

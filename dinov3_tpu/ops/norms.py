@@ -16,11 +16,17 @@ from dinov3_tpu.ops.common import part
 
 
 class LayerNorm(nn.Module):
-    """LayerNorm: fp32 stats, params in param_dtype, output in input dtype."""
+    """LayerNorm: fp32 stats, params in param_dtype, output in input dtype.
+
+    On TPU the forward/backward run as the fused Pallas kernel
+    (ops/fused_norm.py) — one read, in-register fp32 statistics, one write —
+    when the width is lane-aligned; elsewhere the identical math goes
+    through plain XLA ops."""
 
     epsilon: float = 1e-6
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
+    fused: bool = True
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -29,6 +35,10 @@ class LayerNorm(nn.Module):
                            self.param_dtype)
         bias = self.param("bias", part(nn.initializers.zeros, ("embed",)), (dim,),
                           self.param_dtype)
+        if self.fused and self.reduce_dtype == jnp.float32:
+            from dinov3_tpu.ops.fused_norm import fused_layernorm
+
+            return fused_layernorm(x, scale, bias, self.epsilon)
         xf = x.astype(self.reduce_dtype)
         mean = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)

@@ -129,7 +129,23 @@ def build_train_setup(
 
 
 def put_batch(batch: dict, batch_shardings: dict) -> dict:
-    """Host batch -> sharded device arrays (each host feeds its shard)."""
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, s), dict(batch), batch_shardings
-    )
+    """Host batch -> sharded device arrays (each host feeds its shard).
+
+    Single process: plain ``device_put`` of the (global == local) batch.
+    Multi-host: each host passes only its local shard and the global array
+    is assembled with ``make_array_from_process_local_data`` — no host ever
+    materializes (or decodes) the full global batch (the reference striped
+    sample indices by rank for the same reason, data/samplers.py:49-60).
+    """
+    if jax.process_count() == 1:
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, s), dict(batch), batch_shardings
+        )
+    import numpy as np
+
+    return {
+        k: jax.make_array_from_process_local_data(
+            batch_shardings[k], np.asarray(v)
+        )
+        for k, v in dict(batch).items()
+    }

@@ -77,34 +77,110 @@ def get_args_parser():
     return p
 
 
-def build_data_iterator(cfg, global_batch_size: int):
-    """Host-side data iterator yielding collated numpy batches."""
+def build_data_iterator(cfg, global_batch_size: int, rank: int = 0,
+                        world_size: int = 1, start_iter: int = 0):
+    """Host-side data iterator yielding collated numpy batches.
+
+    Each host yields only its ``global/world`` shard (the reference striped
+    by rank in EpochSampler, dinov3_jax/data/samplers.py:49-60), and
+    ``start_iter`` resumes the data stream mid-run instead of replaying it
+    from batch 0 (reference intent: dinov3_jax/train/train.py:840).
+    """
+    if global_batch_size % max(1, world_size):
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{world_size} hosts"
+        )
     backend = cfg.data.backend
     if backend == "synthetic":
         from dinov3_tpu.data import SyntheticDataset
+        from dinov3_tpu.data.multires import (
+            CombineDataLoader,
+            multires_subconfigs,
+            split_advance,
+        )
 
-        return iter(SyntheticDataset(cfg, global_batch_size,
-                                     seed=cfg.train.seed))
+        local = global_batch_size // max(1, world_size)
+        subs = multires_subconfigs(cfg)
+        if subs is None:
+            return iter(SyntheticDataset(
+                cfg, local, seed=cfg.train.seed, rank=rank,
+                world_size=world_size, advance=start_iter,
+            ))
+        # multi-resolution recipes (crop-size lists) get one synthetic
+        # stream per resolution, combined exactly like the real pipeline
+        ratios = [r for _, r in subs]
+        counts = split_advance(cfg.train.seed, ratios, start_iter)
+        loaders = [
+            iter(SyntheticDataset(
+                sub, local, seed=cfg.train.seed + 7919 * j, rank=rank,
+                world_size=world_size, advance=int(counts[j]),
+            ))
+            for j, (sub, _) in enumerate(subs)
+        ]
+        combined = CombineDataLoader(loaders, ratios, seed=cfg.train.seed)
+        if start_iter:
+            combined.advance(start_iter)
+        return iter(combined)
     if backend in ("folder", "imagenet"):
-        from dinov3_tpu.data.pipeline import make_train_pipeline
+        from dinov3_tpu.data.pipeline import make_multires_train_pipeline
 
-        return make_train_pipeline(cfg, global_batch_size)
+        # routes to the single-resolution pipeline unless the recipe
+        # declares crop-size lists (vit7b16_high_res_adapt.yaml)
+        return make_multires_train_pipeline(
+            cfg, global_batch_size, rank=rank, world_size=world_size,
+            sampler_advance_batches=start_iter,
+        )
     raise ValueError(f"unknown data backend {backend!r}")
 
 
-def do_train(cfg, args) -> dict:
+def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
+             process_group=None, group_name=None) -> dict:
+    """Train one model. With the keyword arguments a multidistillation
+    subgroup trains its student on a device-subset mesh: ``devices`` are
+    the group's devices, ``data_rank``/``data_world`` its host-shard
+    coordinates, ``process_group`` its process indices (checkpoint barrier
+    scope)."""
     from dinov3_tpu.configs import global_batch_size
+    from dinov3_tpu.parallel import process_count, process_index
 
-    n_devices = jax.device_count()
-    B = global_batch_size(cfg)
+    n_devices = len(devices) if devices is not None else jax.device_count()
+    B = global_batch_size(cfg, n_devices)
+    rank = data_rank if data_rank is not None else process_index()
+    world = data_world if data_world is not None else process_count()
 
-    data_iter = build_data_iterator(cfg, B)
-    first = {k: jnp.asarray(v) for k, v in next(data_iter).items()}
+    ckpt = Checkpointer(
+        f"{cfg.train.output_dir}/ckpt",
+        max_to_keep=cfg.checkpointing.max_to_keep,
+        keep_every=cfg.checkpointing.get("keep_every"),
+        process_group=process_group,
+        sync_prefix=group_name,
+    )
+    # the resume point decides where the data stream starts, so it must be
+    # known before the iterator is built
+    start_iter = 0
+    resuming = not args.no_resume and ckpt.latest_step() is not None
+    if resuming:
+        start_iter = int(ckpt.latest_step())
+
+    data_iter = build_data_iterator(cfg, B, rank=rank, world_size=world,
+                                    start_iter=start_iter)
+    first = next(data_iter)
+    # setup traces with *global* shapes; the example's values never reach
+    # the trained params (init depends only on the rng), so a zeros batch
+    # keeps the traced constant identical across hosts
+    if world > 1:
+        example = {
+            k: jnp.zeros((v.shape[0] * world,) + v.shape[1:], v.dtype)
+            for k, v in first.items()
+        }
+    else:
+        example = {k: jnp.asarray(v) for k, v in first.items()}
     t0 = time.perf_counter()
-    setup = build_train_setup(cfg, first)
+    setup = build_train_setup(cfg, example, devices=devices)
     logger.info(
-        "mesh %s | global batch %d | %d devices | setup %.1fs",
-        dict(setup.mesh.shape), B, n_devices, time.perf_counter() - t0,
+        "mesh %s | global batch %d | %d devices x %d hosts | setup %.1fs",
+        dict(setup.mesh.shape), B, n_devices, world, time.perf_counter() - t0,
     )
 
     if args.self_check:
@@ -121,15 +197,14 @@ def do_train(cfg, args) -> dict:
     if args.max_iterations > 0:
         total_iters = min(total_iters, args.max_iterations)
 
-    ckpt = Checkpointer(
-        f"{cfg.train.output_dir}/ckpt",
-        max_to_keep=cfg.checkpointing.max_to_keep,
-        keep_every=cfg.checkpointing.get("keep_every"),
-    )
     state = setup.state
-    start_iter = 0
-    if not args.no_resume and ckpt.latest_step() is not None:
+    if resuming:
         state = ckpt.restore(state)
+        if int(state.step) != start_iter:
+            logger.warning(
+                "restored step %d != announced latest %d; data stream "
+                "advanced by the announced value", int(state.step), start_iter,
+            )
         start_iter = int(state.step)
         logger.info("resumed at iteration %d", start_iter)
     elif cfg.distillation.enabled and cfg.distillation.checkpoint_path:
@@ -167,20 +242,23 @@ def do_train(cfg, args) -> dict:
 
     logger.info("parameters:\n%s", format_parameter_counts(
         count_parameters(state.params)))
-    # metrics are cross-device means, identical on every host: record and
-    # compare only on the main process (the file may only exist there)
+    # metrics are cross-device means, identical on every host of this
+    # (sub)group: record and compare only on the group's primary host
+    # (global rank 0 normally; the lowest group rank under
+    # multidistillation, where each student owns its output dir)
+    main_here = rank == 0
     recorder = (LossRecorder(args.record_losses)
-                if args.record_losses and is_main_process() else None)
+                if args.record_losses and main_here else None)
     comparator = (LossComparator(args.ref_losses)
-                  if args.ref_losses and is_main_process() else None)
+                  if args.ref_losses and main_here else None)
     bench_n = max(0, int(args.benchmark))
     step_times: list = []
 
     metric_logger = MetricLogger(
         output_file=f"{cfg.train.output_dir}/training_metrics.json"
-        if is_main_process() else None,
+        if main_here else None,
         tensorboard_dir=f"{cfg.train.output_dir}/tb"
-        if (args.tensorboard and is_main_process()) else None,
+        if (args.tensorboard and main_here) else None,
     )
     rng = jax.random.key(cfg.train.seed + 1)
     nan_streak = 0
@@ -199,8 +277,7 @@ def do_train(cfg, args) -> dict:
 
     preemption = PreemptionHandler().__enter__()
 
-    batch0 = put_batch(first, setup.batch_shardings)
-    pending = batch0
+    pending = put_batch(first, setup.batch_shardings)
     for it, raw in metric_logger.log_every(
         data_iter, print_freq=10, header=header,
         n_iterations=total_iters, start_iteration=start_iter,
@@ -210,10 +287,7 @@ def do_train(cfg, args) -> dict:
         if prof and it == prof[0]:
             jax.profiler.start_trace(f"{cfg.train.output_dir}/trace")
         state, metrics = setup.step_fn(state, batch, setup.scalars(it), rng)
-        pending = put_batch(
-            {k: jnp.asarray(v) for k, v in raw.items()},
-            setup.batch_shardings,
-        )
+        pending = put_batch(raw, setup.batch_shardings)
 
         # host-side schedule values for the log line; one device->host
         # fetch of the metrics, shared by every consumer below
@@ -309,13 +383,62 @@ def main(argv=None):
         # the step op-by-op on the first non-finite value and raises at
         # the producing op.
         jax.config.update("jax_debug_nans", True)
-    initialize_distributed()
     cfg = load_config(args.config_file or None, overrides=list(args.opts))
+    device = str((cfg.get("MODEL") or {}).get("DEVICE", "tpu") or "tpu")
+    if device not in ("tpu", ""):
+        # MODEL.DEVICE=cpu runs the trainer on the host backend (CPU smoke
+        # runs in images whose sitecustomize pre-imports jax, where the
+        # JAX_PLATFORMS env var is read too late to take effect)
+        try:
+            jax.config.update("jax_platforms", device)
+        except RuntimeError as e:  # backend already initialized
+            logger.warning("MODEL.DEVICE=%s ignored: %s", device, e)
+    initialize_distributed()
     cfg.train.output_dir = args.output_dir
+    if cfg.multidistillation.enabled:
+        return do_train_multidistillation(cfg, args)
     setup_job(cfg)
     setup_logging(args.output_dir)
     logger.info("config:\n%s", cfg)
     return do_train(cfg, args)
+
+
+def do_train_multidistillation(cfg, args) -> dict:
+    """Route this host into its student's rank-span subgroup and train the
+    student on the subgroup's device mesh — one independent SPMD program
+    per group, no cross-group collectives (the teacher is frozen).
+
+    (reference spec: dinov3_jax/models/temp.py:109-170 +
+    configs/train/dinov3_vitl16_lvd1689m_distilled.yaml:158-176; its
+    meta-arch and setup bodies were stubs — SURVEY.md §2.5.)
+    """
+    from dinov3_tpu.parallel import process_count, process_index
+    from dinov3_tpu.train.multidistillation import setup_multidistillation
+
+    assignment = setup_multidistillation(
+        cfg, process_index(), process_count(), args.output_dir,
+        extra_overrides=[o for o in args.opts if "=" in o],
+    )
+    scfg = assignment.cfg
+    setup_job(scfg)
+    setup_logging(assignment.output_dir)
+    logger.info("multidistillation student %r config:\n%s",
+                assignment.name, scfg)
+    group = set(assignment.group_ranks)
+    devices = [d for d in jax.devices() if d.process_index in group]
+    if not devices:
+        raise RuntimeError(
+            f"no devices for group ranks {sorted(group)} "
+            f"(process {process_index()} of {process_count()})"
+        )
+    return do_train(
+        scfg, args,
+        devices=devices,
+        data_rank=assignment.group_rank,
+        data_world=len(assignment.group_ranks),
+        process_group=tuple(sorted(group)),
+        group_name=assignment.name,
+    )
 
 
 if __name__ == "__main__":

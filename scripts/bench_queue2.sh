@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-2 TPU measurement queue (run after sweep1 frees the chip):
+#  1. new default (bf16 probs + qkv slices) vs fp32-probs control
+#  2. flash-vs-XLA crossover at the high-res regimes (VERDICT #7)
+set -x
+cd /root/repo
+
+python scripts/bench_sweep.py \
+    "probs16:" \
+    "probs32:_overrides=compute_precision.probs_dtype=fp32" \
+    2>&1
+
+BENCH_RES=512 BENCH_BATCH=2 python scripts/bench_sweep.py \
+    "hr512_auto:" \
+    "hr512_xla:_overrides=kernels.flash_attention=xla" \
+    2>&1
+
+BENCH_RES=768 BENCH_BATCH=1 python scripts/bench_sweep.py \
+    "hr768_auto:" \
+    "hr768_xla:_overrides=kernels.flash_attention=xla" \
+    2>&1

@@ -1,0 +1,133 @@
+"""Capture a jax.profiler trace of the ViT-L fused train step and print a
+per-op-category device-time breakdown (reads the trace.json.gz xplane dump).
+
+Usage: python scripts/profile_step.py [outdir]
+Env: BENCH_ARCH/BENCH_BATCH/BENCH_RES as in bench.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def categorize(name: str) -> str:
+    n = name.lower()
+    if "fusion" not in n and ("dot" in n or "conv" in n):
+        return "matmul/conv"
+    for key in ("all-gather", "all-reduce", "reduce-scatter", "collective",
+                "psum", "permute"):
+        if key in n:
+            return "collective"
+    if "softmax" in n or "exp" in n:
+        return "softmax/exp"
+    if "norm" in n or "rsqrt" in n or "reduce" in n:
+        return "norm/reduce"
+    if "copy" in n or "transpose" in n or "reshape" in n or "bitcast" in n:
+        return "copy/layout"
+    if "fusion" in n:
+        return "fusion/elementwise"
+    return "other"
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.data import make_synthetic_batch
+    from dinov3_tpu.train import build_train_setup, put_batch
+
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/prof_r2"
+    arch = os.environ.get("BENCH_ARCH", "vit_large")
+    per_chip = int(os.environ.get("BENCH_BATCH", "8"))
+    res = int(os.environ.get("BENCH_RES", "0"))
+
+    n = jax.device_count()
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        f"student.arch={arch}",
+        "student.n_storage_tokens=4",
+        "student.drop_path_rate=0.3",
+        "optim.scaling_rule=none",
+        "parallel.data=-1",
+        "compute_precision.param_dtype=bf16",
+    ] + ([f"crops.global_crops_size={res}",
+          f"crops.local_crops_size={max(96, res // 4)}"] if res else []))
+    B = per_chip * n
+    batch_np = make_synthetic_batch(cfg, B, seed=0)
+    batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+
+    t0 = time.perf_counter()
+    setup = build_train_setup(cfg, batch)
+    dbatch = put_batch(batch, setup.batch_shardings)
+    rng = jax.random.key(0)
+    state = setup.state
+    scalars = setup.scalars(0)
+    print(f"setup {time.perf_counter() - t0:.1f}s", flush=True)
+
+    t0 = time.perf_counter()
+    for _ in range(3):
+        state, metrics = setup.step_fn(state, dbatch, scalars, rng)
+    float(metrics["total_loss"])
+    print(f"warmup(3) {time.perf_counter() - t0:.1f}s", flush=True)
+
+    steps = 6
+    t0 = time.perf_counter()
+    jax.profiler.start_trace(outdir)
+    for _ in range(steps):
+        state, metrics = setup.step_fn(state, dbatch, scalars, rng)
+    float(metrics["total_loss"])
+    jax.profiler.stop_trace()
+    dt = (time.perf_counter() - t0) / steps
+    print(f"step {dt * 1e3:.1f} ms  ->  {B / dt / n:.1f} img/s/chip", flush=True)
+
+    # parse newest trace.json.gz
+    paths = sorted(glob.glob(os.path.join(
+        outdir, "**", "*.trace.json.gz"), recursive=True), key=os.path.getmtime)
+    if not paths:
+        print("no trace.json.gz found", flush=True)
+        return
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # find TPU device pids (thread names like "XLA Op" under device pids)
+    by_cat = defaultdict(float)
+    by_name = defaultdict(float)
+    total = 0.0
+    pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
+                 for e in events if e.get("name") == "process_name"}
+    dev_pids = {p for p, nm in pid_names.items()
+                if nm and ("TPU" in nm or "/device:" in nm)}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "")
+        dur = e.get("dur", 0) / 1e3  # us -> ms
+        if not name or dur <= 0:
+            continue
+        by_cat[categorize(name)] += dur
+        by_name[name] += dur
+        total += dur
+    per_step = total / steps
+    print(f"\ndevice total {total:.1f} ms over {steps} steps "
+          f"({per_step:.1f} ms/step)")
+    print("\n== by category (ms/step) ==")
+    for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:24s} {v / steps:8.2f}  ({100 * v / total:5.1f}%)")
+    print("\n== top 30 ops (ms/step) ==")
+    for k, v in sorted(by_name.items(), key=lambda kv: -kv[1])[:30]:
+        print(f"  {v / steps:8.3f}  {k[:120]}")
+
+
+if __name__ == "__main__":
+    main()

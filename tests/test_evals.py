@@ -76,3 +76,75 @@ def test_do_eval_end_to_end():
     )
     assert 0.0 <= results["knn_top1"] <= 1.0
     assert 0.0 <= results["linear_top1"] <= 1.0
+
+
+def test_linear_probe_sweep_grid():
+    """The vmapped lr x wd grid trains every probe jointly; the best one
+    separates the blobs and the grid reports one acc per combo."""
+    from dinov3_tpu.evals.linear import linear_probe_sweep
+
+    train_x, train_y = _blobs(50, 5, 16, seed=0)
+    test_x, test_y = _blobs(20, 5, 16, seed=1)
+    best, grid = linear_probe_sweep(
+        train_x, train_y, test_x, test_y, n_classes=5,
+        lrs=(1e-3, 1e-1, 0.5), wds=(0.0, 1e-4), epochs=15, batch_size=64,
+    )
+    assert len(grid) == 6
+    assert best == max(grid.values())
+    assert best > 0.95
+
+
+def test_knn_eval_multi_ks():
+    from dinov3_tpu.evals.knn import knn_eval_multi
+
+    train_x, train_y = _blobs(50, 5, 16, seed=0)
+    test_x, test_y = _blobs(20, 5, 16, seed=1)
+    res = knn_eval_multi(train_x, train_y, test_x, test_y, n_classes=5)
+    assert set(res) == {"knn10_top1", "knn20_top1"}
+    assert max(res.values()) > 0.9
+
+
+def test_standalone_eval_cli(tmp_path):
+    """python -m dinov3_tpu.evals --ckpt ... runs the full protocol path
+    (sweep + multi-k) against a trained checkpoint, standalone
+    (VERDICT r1 next-round #6)."""
+    import json
+
+    from dinov3_tpu.evals.__main__ import main as eval_main
+    from dinov3_tpu.train.train import main as train_main
+
+    out = tmp_path / "run"
+    common = [
+        "student.arch=vit_test", "student.patch_size=4",
+        "crops.global_crops_size=16", "crops.local_crops_size=8",
+        "crops.local_crops_number=2",
+        "dino.head_n_prototypes=64", "dino.head_hidden_dim=32",
+        "dino.head_bottleneck_dim=16",
+        "ibot.head_n_prototypes=64", "ibot.head_hidden_dim=32",
+        "ibot.head_bottleneck_dim=16",
+        "train.batch_size_per_device=2",
+        "optim.scaling_rule=none",
+    ]
+    train_main(["--output-dir", str(out), "--no-resume"] + common + [
+        "train.OFFICIAL_EPOCH_LENGTH=2", "optim.epochs=1",
+        "optim.warmup_epochs=0", "data.backend=synthetic",
+    ])
+    results = eval_main([
+        "--ckpt", str(out / "ckpt"),
+        "--batch-size", "8",
+        "--probe-epochs", "2",
+        "--max-train-samples", "32",
+        "--max-val-samples", "16",
+        "--output", str(tmp_path / "eval.json"),
+    ] + common + [
+        "+evaluation.train_dataset_path="
+        "Synthetic:split=TRAIN:size=64:image_size=24:n_classes=4",
+        "+evaluation.val_dataset_path="
+        "Synthetic:split=VAL:size=32:image_size=24:n_classes=4",
+        "train.num_workers=2",
+    ])
+    assert "linear_sweep" in results and len(results["linear_sweep"]) >= 2
+    assert {"knn10_top1", "knn20_top1", "knn_top1",
+            "linear_top1"} <= set(results)
+    on_disk = json.loads((tmp_path / "eval.json").read_text())
+    assert on_disk["linear_top1"] == results["linear_top1"]

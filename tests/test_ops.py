@@ -384,3 +384,40 @@ def test_causal_block_runs():
     params = blk.init(jax.random.key(1), x)
     y = blk.apply(params, x)
     assert y.shape == x.shape and bool(jnp.isfinite(y).all())
+
+
+def test_xla_attention_bf16_probs_parity():
+    """compute_precision.probs_dtype=bf16: same attention within bf16
+    tolerance, fwd and grads (fp32 statistics both ways)."""
+    from dinov3_tpu.ops.attention import xla_attention
+
+    k1, k2, k3, k4 = jax.random.split(jax.random.key(7), 4)
+    q = jax.random.normal(k1, (2, 33, 4, 16), jnp.float32)
+    k = jax.random.normal(k2, (2, 33, 4, 16), jnp.float32)
+    v = jax.random.normal(k3, (2, 33, 4, 16), jnp.float32)
+    ct = jax.random.normal(k4, (2, 33, 4, 16), jnp.float32)
+
+    def loss(probs_dtype):
+        return lambda q, k, v: jnp.sum(
+            xla_attention(q, k, v, probs_dtype=probs_dtype) * ct)
+
+    o32 = xla_attention(q, k, v)
+    o16 = xla_attention(q, k, v, probs_dtype=jnp.bfloat16)
+    assert jnp.abs(o16 - o32).max() < 2e-2
+    g32 = jax.grad(loss(None), argnums=(0, 1, 2))(q, k, v)
+    g16 = jax.grad(loss(jnp.bfloat16), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g16, g32):
+        assert jnp.abs(a - b).max() < 3e-2
+
+
+def test_probs_dtype_threads_from_config():
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.models import backbone_kwargs_from_cfg
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, ["student.arch=vit_test"])
+    kw = backbone_kwargs_from_cfg(cfg)
+    assert kw["probs_dtype"] == jnp.bfloat16
+    apply_dot_overrides(cfg, ["compute_precision.probs_dtype=fp32"])
+    kw = backbone_kwargs_from_cfg(cfg)
+    assert kw["probs_dtype"] == jnp.float32

