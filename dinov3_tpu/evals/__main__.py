@@ -39,6 +39,9 @@ def get_args_parser():
 
 
 def main(argv=None):
+    from dinov3_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
     args = get_args_parser().parse_args(argv)
 
     from dinov3_tpu.configs import load_config

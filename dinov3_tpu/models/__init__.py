@@ -117,7 +117,12 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
     return kw
 
 
-def build_backbone(cfg: ConfigNode, *, teacher: bool = False):
+def build_backbone(cfg: ConfigNode, *, teacher: bool = False,
+                   param_dtype=None):
+    """``param_dtype`` overrides the config policy's parameter dtype —
+    the training path passes fp32 so masters (and initializer samples)
+    never round through bf16 (ssl_meta_arch.py), while eval builds keep
+    the recipe's storage dtype."""
     arch = cfg.student.arch
     if arch.startswith("convnext"):
         from dinov3_tpu.models.convnext import (
@@ -125,12 +130,16 @@ def build_backbone(cfg: ConfigNode, *, teacher: bool = False):
             get_convnext_arch,
         )
 
-        return get_convnext_arch(arch)(
-            **convnext_kwargs_from_cfg(cfg, teacher=teacher)
-        )
+        kw = convnext_kwargs_from_cfg(cfg, teacher=teacher)
+        if param_dtype is not None:
+            kw["param_dtype"] = param_dtype
+        return get_convnext_arch(arch)(**kw)
     if arch not in ARCHS:
         raise ValueError(f"unknown arch {arch!r}; available: {sorted(ARCHS)}")
-    return ARCHS[arch](**backbone_kwargs_from_cfg(cfg, teacher=teacher))
+    kw = backbone_kwargs_from_cfg(cfg, teacher=teacher)
+    if param_dtype is not None:
+        kw["param_dtype"] = param_dtype
+    return ARCHS[arch](**kw)
 
 
 def build_model_from_cfg(cfg: ConfigNode, only_teacher: bool = False):

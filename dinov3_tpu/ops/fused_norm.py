@@ -15,11 +15,16 @@ control — dinov3_jax/layers/rms_norm.py and nn.LayerNorm call sites.)
 
 Dispatch contract (``fused_layernorm``):
 - Pallas kernel on a TPU backend when the trailing dim is lane-aligned
-  (D % 128 == 0) and no multi-device mesh is active (an opaque custom call
-  inside a GSPMD program would force replication; multichip keeps XLA's
-  natively-partitionable lowering);
+  (D % 128 == 0);
+- under a multi-device mesh the kernel runs inside a ``shard_map`` island
+  over the row-sharded activation: LayerNorm is row-local (statistics
+  reduce over D only, which is never sharded — parallel/sharding.py maps
+  ``embed_act`` to None), so each device normalizes its own rows and no
+  collective is needed. Without the island an opaque custom call inside a
+  GSPMD program would force replication;
 - identical fp32 math through plain XLA ops otherwise (CPU test meshes,
-  odd widths) — same values, same gradients.
+  odd widths, row counts not divisible by the mesh's data axes) — same
+  values, same gradients.
 """
 
 from __future__ import annotations
@@ -177,12 +182,31 @@ def use_pallas_layernorm(D: int) -> bool:
 
     if os.environ.get("DINOV3_FUSED_LN", "0") != "1":
         return False
-    if jax.default_backend() != "tpu" or D % 128 != 0:
-        return False
-    from dinov3_tpu.parallel.context import get_current_mesh
+    return jax.default_backend() == "tpu" and D % 128 == 0
 
-    mesh = get_current_mesh()
-    return mesh is None or mesh.size <= 1
+
+def _island_specs(mesh, shape):
+    """PartitionSpecs for running the row-local kernel per-shard under a
+    multi-device mesh: rows (dim 0) over the data axes, tokens (dim 1 of
+    rank-3 activations) over ``seq``, D unsharded. Returns None when the
+    shape does not divide the mesh, or under pipeline parallelism — there
+    the norms run inside the stage-vmapped pipeline body whose buffers are
+    sharded over ``pipe``, a layout these specs cannot express (caller
+    falls back to XLA)."""
+    from jax.sharding import PartitionSpec as P
+
+    from dinov3_tpu.parallel.mesh import data_axes, data_parallel_size
+
+    if int(mesh.shape.get("pipe", 1)) > 1:
+        return None
+    if shape[0] % data_parallel_size(mesh) != 0:
+        return None
+    mid = [None] * (len(shape) - 2)
+    if len(shape) >= 3 and int(mesh.shape.get("seq", 1)) > 1:
+        if shape[1] % int(mesh.shape["seq"]) != 0:
+            return None
+        mid[0] = "seq"
+    return P(data_axes(mesh), *mid, None)
 
 
 def fused_layernorm(
@@ -204,12 +228,41 @@ def fused_layernorm(
         return _xla_layernorm(x, scale.reshape(D), bias.reshape(D), eps)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+
+    from dinov3_tpu.parallel.context import get_current_mesh
+
+    mesh = get_current_mesh()
+    if mesh is not None and mesh.size > 1:
+        from jax.sharding import PartitionSpec as P
+
+        spec = _island_specs(mesh, x.shape)
+        if spec is None:
+            return _xla_layernorm(x, scale.reshape(D), bias.reshape(D), eps)
+
+        def _local(xs, s, b):
+            return _ln_nd(xs, s, b, float(eps), interpret)
+
+        return jax.shard_map(
+            _local, mesh=mesh,
+            in_specs=(spec, P(None), P(None)),
+            out_specs=spec,
+            # no collectives in the island (row-local math); pallas_call's
+            # out_shape carries no vma so the varying-axes check must be off
+            check_vma=False,
+        )(x, scale.reshape(D), bias.reshape(D))
+
+    return _ln_nd(x, scale, bias, float(eps), interpret)
+
+
+def _ln_nd(x, scale, bias, eps, interpret):
+    """Flatten leading dims, run the 2-D kernel, restore the shape."""
+    D = x.shape[-1]
     lead = x.shape[:-1]
     R = 1
     for s in lead:
         R *= s
     y = _ln_2d(
         x.reshape(R, D), scale.reshape(1, D), bias.reshape(1, D),
-        float(eps), interpret,
+        eps, interpret,
     )
     return y.reshape(*lead, D)

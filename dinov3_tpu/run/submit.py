@@ -264,6 +264,9 @@ def get_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
+    from dinov3_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
     logging.basicConfig(level=logging.INFO)
     args, script_args = get_parser().parse_known_args(argv)
     if not os.path.exists(args.module_path):

@@ -55,8 +55,24 @@ class SSLMetaArch:
         if not (0 <= lo < hi <= 1):
             raise ValueError("provide a valid ibot.mask_ratio_min_max")
         self.cfg = cfg
-        self.policy = Policy.from_cfg(cfg.compute_precision)
-        self.student_backbone = build_backbone(cfg, teacher=False)
+        # Training masters are ALWAYS fp32, whatever compute_precision.
+        # param_dtype says: the reference recipe's ``param_dtype: bf16`` is
+        # torch-FSDP MixedPrecision's *compute copy* dtype — its masters
+        # (and initializer samples) stay fp32 (SURVEY.md §2.5). bf16
+        # masters would freeze both EMAs by rounding: the teacher update
+        # (1-m)(s-t) and Adam's second-moment increment (1-b2)g² both fall
+        # below the bf16 half-ulp of their accumulators in steady state.
+        # Modules cast to ``compute_dtype`` (bf16) at apply time, so the
+        # MXU path is unaffected; ``param_dtype`` keeps its configured
+        # value for eval/inference builds (models/__init__.py), where
+        # low-precision storage is safe.
+        import dataclasses as _dc
+
+        self.policy = _dc.replace(
+            Policy.from_cfg(cfg.compute_precision), param_dtype=jnp.float32
+        )
+        self.student_backbone = build_backbone(
+            cfg, teacher=False, param_dtype=self.policy.param_dtype)
         # Distillation: the teacher is a different (frozen, pretrained)
         # architecture resolved from its own config
         # (reference: ssl_meta_arch.py _setup_distillation:257-286).
@@ -67,7 +83,8 @@ class SSLMetaArch:
 
             teacher_cfg = resolve_distillation_cfg(cfg)
         self.teacher_cfg = teacher_cfg
-        self.teacher_backbone = build_backbone(teacher_cfg, teacher=True)
+        self.teacher_backbone = build_backbone(
+            teacher_cfg, teacher=True, param_dtype=self.policy.param_dtype)
         self.embed_dim = self.student_backbone.embed_dim
         self.teacher_embed_dim = self.teacher_backbone.embed_dim
 
@@ -180,7 +197,16 @@ class SSLMetaArch:
         params = {"student": student, "teacher": teacher}
         if self.gram_enabled and not self.gram_uses_ema_teacher:
             params["gram"] = jax.tree.map(jnp.copy, {"backbone": bb})
-        return params
+
+        # Belt-and-braces for the fp32-master contract (the policy above
+        # already initializes in fp32): catches any module that hardcodes
+        # its own param dtype.
+        def _master(x):
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(jnp.float32)
+            return x
+
+        return jax.tree.map(_master, params)
 
     def init_state(self) -> dict:
         """Non-param training state (softmax-centering EMA centers)."""
@@ -514,10 +540,21 @@ class SSLMetaArch:
         (SURVEY.md §2.9.1); here the result IS the teacher used next step.
         Under distillation the teacher is a frozen pretrained model and is
         returned unchanged.
+
+        The arithmetic runs in fp32 and the result is cast back to the
+        teacher's storage dtype — fp32 by construction (``init_params``
+        forces fp32 masters), so the cast is an identity there; it guards
+        the signature for restored checkpoints in other dtypes. Without
+        it, ``t * momentum`` (bf16 × fp32 scalar array) silently promoted
+        a bf16 teacher to fp32 after the first step — changing the step
+        signature (a second full XLA compile on step 2).
         """
         if self.distillation:
             return teacher_params
         return jax.tree.map(
-            lambda t, s: t * momentum + s.astype(t.dtype) * (1.0 - momentum),
+            lambda t, s: (
+                t.astype(jnp.float32) * momentum
+                + s.astype(jnp.float32) * (1.0 - momentum)
+            ).astype(t.dtype),
             teacher_params, student_params,
         )

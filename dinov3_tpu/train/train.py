@@ -376,6 +376,9 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
 
 
 def main(argv=None):
+    from dinov3_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
     args = get_args_parser().parse_args(argv)
     if args.debug_nans:
         # SURVEY.md §5.2: the reference had no sanitizer story beyond

@@ -130,3 +130,21 @@ def dump_weights(path: str, params) -> None:
     if jax.process_index() == 0:
         np.savez(path, **flat)
         logger.info("dumped %d arrays to %s", len(flat), path)
+
+
+def respect_jax_platforms_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative over sitecustomize config pins.
+
+    The axon image's sitecustomize pins ``jax_platforms="axon,cpu"`` via
+    ``jax.config``, which outranks the environment variable — so a
+    ``JAX_PLATFORMS=cpu`` run of any CLI entry point would still attempt
+    (and, when the TPU tunnel is down, hang in) axon backend init. Call
+    before first device use from every entry point."""
+    import os
+
+    env_plat = os.environ.get("JAX_PLATFORMS", "")
+    if env_plat and "axon" not in env_plat:
+        try:
+            jax.config.update("jax_platforms", env_plat)
+        except RuntimeError:
+            pass  # backend already initialized; too late to change

@@ -73,6 +73,9 @@ def main():
     specs = sys.argv[1:] or ["fused:DINOV3_FUSED_LN=1", "base:DINOV3_FUSED_LN=0"]
     import jax
 
+    from dinov3_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
     jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
     results = {}
     for spec in specs:

@@ -41,6 +41,10 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from dinov3_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
+
     jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 
     from dinov3_tpu.configs import apply_dot_overrides, get_default_config

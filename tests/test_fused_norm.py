@@ -88,6 +88,75 @@ def test_layernorm_module_dispatch_off_tpu():
     assert not use_pallas_layernorm(1024)
 
 
+@pytest.mark.parametrize("axes,shape", [
+    ({"data": -1, "fsdp": 2}, (8, 6, 256)),         # rows over data x fsdp
+    ({"data": -1, "fsdp": 2, "seq": 2}, (4, 8, 256)),  # tokens over seq too
+    ({"data": -1}, (16, 128)),                       # rank-2 (head MLP rows)
+])
+def test_fused_layernorm_multidevice_island_parity(eight_devices, axes, shape):
+    """VERDICT r2 #2: the Pallas kernel must stay legal under a multi-device
+    mesh — a shard_map island over the row-sharded activation, exact parity
+    with the XLA lowering, forward and backward, under jit+GSPMD."""
+    from dinov3_tpu.parallel import build_mesh
+    from dinov3_tpu.parallel.context import get_current_mesh, set_current_mesh
+    from dinov3_tpu.parallel.mesh import MeshSpec
+
+    mesh = build_mesh(MeshSpec(**axes), devices=eight_devices)
+    D = shape[-1]
+    k1, k2, k3, k4 = jax.random.split(jax.random.key(5), 4)
+    x = jax.random.normal(k1, shape, jnp.float32) * 2 + 0.5
+    s = jax.random.normal(k2, (D,), jnp.float32) + 1
+    b = jax.random.normal(k3, (D,), jnp.float32)
+    ct = jax.random.normal(k4, shape, jnp.float32)
+
+    prev = get_current_mesh()
+    set_current_mesh(mesh)
+    try:
+        assert mesh.size > 1
+
+        def loss(fn):
+            return lambda x, s, b: jnp.sum(fn(x, s, b) * ct)
+
+        fused = jax.jit(jax.value_and_grad(loss(_pallas), argnums=(0, 1, 2)))
+        plain = jax.jit(jax.value_and_grad(
+            loss(lambda x, s, b: _xla_layernorm(x, s, b, 1e-6)),
+            argnums=(0, 1, 2),
+        ))
+        got_v, got_g = fused(x, s, b)
+        want_v, want_g = plain(x, s, b)
+        np.testing.assert_allclose(float(got_v), float(want_v),
+                                   rtol=2e-5, atol=2e-5)
+        for g, w in zip(got_g, want_g):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-5, atol=2e-5)
+    finally:
+        set_current_mesh(prev)
+
+
+def test_fused_layernorm_multidevice_indivisible_rows_falls_back(
+    eight_devices,
+):
+    """Row counts that don't divide the data axes must fall back to XLA
+    (not crash in shard_map)."""
+    from dinov3_tpu.parallel import build_mesh
+    from dinov3_tpu.parallel.context import get_current_mesh, set_current_mesh
+    from dinov3_tpu.parallel.mesh import MeshSpec
+
+    mesh = build_mesh(MeshSpec(data=-1), devices=eight_devices)
+    prev = get_current_mesh()
+    set_current_mesh(mesh)
+    try:
+        x = jax.random.normal(jax.random.key(6), (7, 128), jnp.float32)
+        s = jnp.ones((128,), jnp.float32)
+        b = jnp.zeros((128,), jnp.float32)
+        got = _pallas(x, s, b)
+        want = _xla_layernorm(x, s, b, 1e-6)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+    finally:
+        set_current_mesh(prev)
+
+
 def test_layernorm_module_fused_flag_equivalence():
     from dinov3_tpu.ops.norms import LayerNorm
 
