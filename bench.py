@@ -68,22 +68,65 @@ def _watchdog(period: float = 60.0) -> None:
     threading.Thread(target=run, daemon=True).start()
 
 
+def _tpu_required() -> bool:
+    """True when this run must produce a TPU number: JAX_PLATFORMS selects
+    axon, or it is unset on an image where the axon plugin is registered."""
+    env_plat = os.environ.get("JAX_PLATFORMS", "")
+    if "axon" in env_plat:
+        return True
+    if env_plat:
+        return False
+    from jax._src import xla_bridge
+
+    return "axon" in getattr(xla_bridge, "_backend_factories", {})
+
+
 def _init_backend_with_retries(jax, retries: int, backoff: float = 20.0):
     """jax.device_count() with retry: a transient axon outage at driver
-    bench time must not zero out the round's evidence (BENCH_r02 lesson)."""
+    bench time must not zero out the round's evidence (BENCH_r02 lesson).
+    A silent fallback to cpu while the TPU was selected counts as a failed
+    attempt too — retried, and fatal (exit 2) only once retries are
+    exhausted, so a CPU number is never recorded as TPU evidence."""
     for attempt in range(retries + 1):
+        err = None
         try:
-            return jax.device_count()
+            n = jax.device_count()
+            if jax.default_backend() != "cpu" or not _tpu_required():
+                return n
+            err = "TPU selected but default backend is cpu (init fell back)"
         except RuntimeError as e:
-            if attempt == retries:
-                raise
-            _log(f"backend init failed (attempt {attempt + 1}/{retries}): "
-                 f"{e}; retrying in {backoff:.0f}s")
-            time.sleep(backoff)
-            from jax._src import xla_bridge
+            err = str(e)
+        if attempt == retries:
+            break
+        _log(f"backend init failed (attempt {attempt + 1}/{retries}): "
+             f"{err}; retrying in {backoff:.0f}s")
+        time.sleep(backoff)
+        from jax._src import xla_bridge
 
-            xla_bridge._clear_backends()
-            backoff *= 2
+        xla_bridge._clear_backends()
+        backoff *= 2
+    _log(f"FATAL: backend init failed after {retries + 1} attempts: {err}")
+    sys.exit(2)
+
+
+def _split_overrides(s: str) -> list[str]:
+    """Split BENCH_OVERRIDES on commas *outside* brackets, so list-valued
+    entries (crops.global_crops_size=[512,768]) survive intact."""
+    out, buf, depth = [], [], 0
+    for ch in s:
+        if ch in "[(":
+            depth += 1
+        elif ch in "])":
+            depth -= 1
+        if ch == "," and depth == 0:
+            if buf:
+                out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if buf:
+        out.append("".join(buf))
+    return out
 
 
 def main():
@@ -116,23 +159,7 @@ def main():
     n = _init_backend_with_retries(
         jax, int(os.environ.get("BENCH_INIT_RETRIES", "4"))
     )
-    backend = jax.default_backend()
-    _log(f"backend={backend} devices={n}")
-    # Guard against silent CPU fallback: when the env selects the TPU
-    # (JAX_PLATFORMS=axon, or unset on an image that has the axon plugin),
-    # a cpu default backend means axon init failed and jax fell back — a
-    # CPU number must never be recorded as the round's TPU evidence. On a
-    # machine without the axon plugin, an unset env runs wherever jax
-    # lands, as the docstring promises.
-    env_plat = os.environ.get("JAX_PLATFORMS", "")
-    from jax._src import xla_bridge as _xb
-
-    axon_registered = "axon" in getattr(_xb, "_backend_factories", {})
-    if ("axon" in env_plat or (not env_plat and axon_registered)) \
-            and backend == "cpu":
-        _log("FATAL: TPU requested but default backend is cpu "
-             "(axon init fell back); refusing to print a CPU number")
-        sys.exit(2)
+    _log(f"backend={jax.default_backend()} devices={n}")
 
     _phase("build")
     cfg = get_default_config()
@@ -155,7 +182,7 @@ def main():
         overrides.append(
             f"compute_precision.probs_dtype={os.environ['BENCH_PROBS']}")
     if os.environ.get("BENCH_OVERRIDES"):
-        overrides += [s for s in os.environ["BENCH_OVERRIDES"].split(",") if s]
+        overrides += _split_overrides(os.environ["BENCH_OVERRIDES"])
     apply_dot_overrides(cfg, overrides)
     B = per_chip * n
     batch_np = make_synthetic_batch(cfg, B, seed=0)

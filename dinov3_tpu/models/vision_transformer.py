@@ -202,30 +202,28 @@ class DinoVisionTransformer(nn.Module):
         )
 
     def _run_blocks(self, x, rope, deterministic, collect: Sequence[int] = ()):
-        """Run the stack; optionally collect outputs of the listed layers."""
+        """Run the stack; optionally collect outputs of the listed layers.
+
+        Every path composes with every other feature: MoE aux losses ride
+        the "losses" collection through scan/vmap (``variable_axes``), and
+        the pipeline collects intermediate layers through per-stage
+        buffers (parallel/pipeline.py)."""
         collected = {}
-        if self.ffn_layer == "moe" and (
-            self.scan_layers or self.pipeline_stages > 1
-        ):
-            raise NotImplementedError(
-                "ffn_layer=moe requires the unrolled block path (its aux "
-                "loss is sown per block): set scan_layers=False, pipe=1"
-            )
-        if self.pipeline_stages > 1 and not collect:
+        if self.pipeline_stages > 1:
             from dinov3_tpu.parallel.pipeline import PipelinedBlocks
 
-            x = PipelinedBlocks(
+            x, collected = PipelinedBlocks(
                 block_kwargs=self._block_kwargs(),
                 n_blocks=self.n_blocks,
                 n_stages=self.pipeline_stages,
                 n_microbatches=self.pipeline_microbatches,
                 remat=self.remat,
                 name="pipeline",
-            )(x, rope, deterministic)
+            )(x, rope, deterministic, collect=tuple(sorted(collect)))
         elif self.scan_layers and not collect:
             scanned = nn.scan(
                 ScanBlockAdapter,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "drop_path": True, "dropout": True},
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=self.n_blocks,
@@ -236,7 +234,7 @@ class DinoVisionTransformer(nn.Module):
             take = tuple(sorted(collect))
             scanned = nn.scan(
                 _CollectScanBlock,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "drop_path": True, "dropout": True},
                 in_axes=(0, nn.broadcast, nn.broadcast),
                 length=self.n_blocks,
@@ -344,11 +342,9 @@ class DinoVisionTransformer(nn.Module):
         norm: bool = True,
     ):
         """Eval-time feature extraction (reference:280-312, with its reshape
-        and index typos fixed)."""
-        if self.pipeline_stages > 1:
-            raise NotImplementedError(
-                "get_intermediate_layers requires pipeline_stages=1"
-            )
+        and index typos fixed). Works on every block-stack layout,
+        including the pipelined one (stage-owned collect buffers,
+        parallel/pipeline.py)."""
         tokens, (h, w) = self._prepare_tokens(x, None)
         rope = self._rope_table(h, w, True)
         take = (

@@ -27,6 +27,7 @@ Batch contract (produced by dinov3_tpu/data/collate.py):
 
 from __future__ import annotations
 
+from math import prod as math_prod
 from typing import Any
 
 import jax
@@ -228,9 +229,34 @@ class SSLMetaArch:
                 {"params": params}, x, masks, crop_kind=crop_kind,
                 deterministic=not train, rngs=rngs, mutable=["losses"],
             )
-            sown = jax.tree.leaves(aux_vars.get("losses", {}))
-            if sown:
-                out["moe_aux_loss"] = sum(jnp.mean(s) for s in sown) / len(sown)
+            flat = jax.tree_util.tree_flatten_with_path(
+                aux_vars.get("losses", {})
+            )[0]
+            terms = []
+            for keypath, leaf in flat:
+                in_pipe = any(
+                    getattr(k, "key", None) == "pipeline" for k in keypath
+                )
+                if in_pipe and leaf.ndim >= 2:
+                    # pipeline-stacked [T(icks), S(tages), blocks/stage]:
+                    # stage s runs a real microbatch only at ticks
+                    # s..s+M-1 (M = T-S+1); bubble slots carry routing
+                    # stats of zero/stale buffers and must not count
+                    T, S = leaf.shape[0], leaf.shape[1]
+                    M = T - S + 1
+                    t = jnp.arange(T)[:, None]
+                    s = jnp.arange(S)[None, :]
+                    valid = (t >= s) & (t - s <= M - 1)
+                    shape = (T, S) + (1,) * (leaf.ndim - 2)
+                    w = valid.astype(leaf.dtype).reshape(shape)
+                    terms.append(
+                        jnp.sum(leaf * w)
+                        / (jnp.sum(w) * math_prod(leaf.shape[2:]))
+                    )
+                else:
+                    terms.append(jnp.mean(leaf))
+            if terms:
+                out["moe_aux_loss"] = sum(terms) / len(terms)
             return out
         return module.apply(
             {"params": params}, x, masks, crop_kind=crop_kind,

@@ -124,12 +124,51 @@ def test_moe_train_step_expert_parallel(eight_devices):
     assert np.isfinite(float(metrics["total_loss"]))
 
 
-def test_moe_rejects_scan_and_pipeline(eight_devices):
-    from dinov3_tpu.models import build_backbone
-
+def test_moe_scan_layers_train_step(eight_devices):
+    """MoE composes with nn.scan over blocks: the aux loss rides the
+    "losses" collection through the scan (variable_axes) — VERDICT r2 #5
+    deleted the NotImplementedError guard."""
     cfg = get_default_config()
-    apply_dot_overrides(cfg, SMOL_MOE + ["train.scan_layers=true"])
-    model = build_backbone(cfg)
-    x = jnp.zeros((1, 16, 16, 3))
-    with pytest.raises(NotImplementedError, match="moe"):
-        model.init(jax.random.key(0), x)
+    apply_dot_overrides(cfg, SMOL_MOE + [
+        "train.scan_layers=true", "parallel.data=-1",
+    ])
+    B = 8
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, B, seed=0).items()}
+    setup = build_train_setup(cfg, batch, devices=eight_devices)
+    dbatch = put_batch(batch, setup.batch_shardings)
+    state, metrics = setup.step_fn(
+        setup.state, dbatch, setup.scalars(0), jax.random.key(0)
+    )
+    assert "moe_aux_loss" in metrics
+    aux = float(metrics["moe_aux_loss"])
+    # Switch aux = E * sum_e f_e p_e is ~1 at balance, <= E always
+    assert 0.5 <= aux <= 2.1, aux
+    assert np.isfinite(float(metrics["total_loss"]))
+
+
+def test_moe_pipeline_train_step(eight_devices):
+    """MoE composes with the GPipe pipeline: per-tick sown aux losses are
+    stacked by the tick scan and bubble slots are masked out of the mean
+    (ssl_meta_arch._apply_backbone)."""
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, SMOL_MOE + [
+        "parallel.data=-1", "parallel.pipe=2",
+    ])
+    B = 8
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, B, seed=0).items()}
+    setup = build_train_setup(cfg, batch, devices=eight_devices)
+    assert setup.mesh.shape["pipe"] == 2
+    dbatch = put_batch(batch, setup.batch_shardings)
+    state, metrics = setup.step_fn(
+        setup.state, dbatch, setup.scalars(0), jax.random.key(0)
+    )
+    assert "moe_aux_loss" in metrics
+    aux = float(metrics["moe_aux_loss"])
+    assert 0.5 <= aux <= 2.1, aux
+    assert np.isfinite(float(metrics["total_loss"]))
+    state, metrics = setup.step_fn(
+        state, dbatch, setup.scalars(1), jax.random.key(0)
+    )
+    assert np.isfinite(float(metrics["total_loss"]))
