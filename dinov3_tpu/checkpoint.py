@@ -50,6 +50,25 @@ class Checkpointer:
             # orbax refuses create=True with active_processes
             os.makedirs(directory, exist_ok=True)
             create = False
+        # A one-host subgroup in a multi-host runtime cannot use orbax at
+        # all: its jax.Array handler refuses fully-addressable arrays
+        # ("host local"), and the numpy/scalar type handlers hardcode
+        # ``multihost.process_index() == 0`` for their writes
+        # (orbax _src/serialization/type_handlers.py:143,217,271,334,382)
+        # — a group whose primary is any other process silently writes an
+        # empty checkpoint. Use a plain npz-per-step local backend there;
+        # the group state is single-host by construction so no
+        # coordination is needed.
+        self._local = (
+            process_group is not None and len(process_group) == 1
+            and jax.process_count() > 1
+        )
+        self._directory = directory
+        self._max_to_keep = max_to_keep
+        self._keep_every = keep_every
+        if self._local:
+            self.manager = None
+            return
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             keep_period=keep_every,
@@ -58,29 +77,88 @@ class Checkpointer:
             **extra,
         )
         self.manager = ocp.CheckpointManager(directory, options=options)
-        # a one-host subgroup in a multi-host runtime produces fully-
-        # addressable arrays, which orbax's jax.Array handler refuses
-        # ("host local") even with active_processes scoped; numpy leaves
-        # take the numpy handler and land in the same zarr layout
-        self._numpy_save = (
-            process_group is not None and len(process_group) == 1
-            and jax.process_count() > 1
+
+    # -------- local npz backend (one-host subgroups) --------
+
+    def _local_steps(self) -> list[int]:
+        import os
+
+        if not os.path.isdir(self._directory):
+            return []
+        return sorted(
+            int(d) for d in os.listdir(self._directory)
+            if d.isdigit()
+            # only this backend's layout: a pre-upgrade orbax step dir
+            # must not be announced as resumable
+            and os.path.exists(os.path.join(self._directory, d, "state.npz"))
         )
+
+    def _local_save(self, step: int, state: TrainState) -> bool:
+        import os
+
+        import numpy as np
+
+        flat = jax.tree_util.tree_flatten_with_path(state)[0]
+        arrays = {
+            jax.tree_util.keystr(path): np.asarray(leaf)
+            for path, leaf in flat
+        }
+        tmp = os.path.join(self._directory, f"tmp.{step}")
+        final = os.path.join(self._directory, str(step))
+        os.makedirs(tmp, exist_ok=True)
+        np.savez(os.path.join(tmp, "state.npz"), **arrays)
+        if os.path.isdir(final):  # overwrite-save of the same step
+            import shutil
+
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        # retention: newest max_to_keep survive, plus every keep_every-th
+        steps = self._local_steps()
+        for s in steps[: -self._max_to_keep or None]:
+            if self._keep_every and s % self._keep_every == 0:
+                continue
+            import shutil
+
+            shutil.rmtree(os.path.join(self._directory, str(s)),
+                          ignore_errors=True)
+        return True
+
+    def _local_restore(self, state_like, step: int, subtree: str = ""):
+        import os
+
+        import numpy as np
+
+        with np.load(
+            os.path.join(self._directory, str(step), "state.npz")
+        ) as z:
+            flat = jax.tree_util.tree_flatten_with_path(state_like)
+            leaves = []
+            for path, like in flat[0]:
+                key = subtree + jax.tree_util.keystr(path)
+                v = z[key]
+                if v.dtype.kind == "V":
+                    # npz stores ml_dtypes (bfloat16, fp8) as raw void
+                    # records; the bytes are intact — reinterpret with the
+                    # like-leaf's dtype
+                    import numpy as np
+
+                    v = v.view(np.dtype(like.dtype))
+                if isinstance(like, jax.Array):
+                    v = jax.device_put(v, like.sharding)
+                leaves.append(v)
+        return jax.tree_util.tree_unflatten(flat[1], leaves)
 
     # -------- save --------
 
     def save(self, step: int, state: TrainState) -> bool:
         """Async save; returns True if a save was started."""
-        if self._numpy_save:
-            import numpy as np
-
-            state = jax.tree.map(
-                lambda v: np.asarray(v) if isinstance(v, jax.Array) else v,
-                state,
+        if self._local:
+            saved = self._local_save(step, state)
+        else:
+            saved = self.manager.save(
+                step,
+                args=ocp.args.Composite(state=ocp.args.StandardSave(state)),
             )
-        saved = self.manager.save(
-            step, args=ocp.args.Composite(state=ocp.args.StandardSave(state))
-        )
         if saved:
             logger.info("checkpoint save started at step %d", step)
         return saved
@@ -88,6 +166,9 @@ class Checkpointer:
     # -------- restore --------
 
     def latest_step(self) -> int | None:
+        if self._local:
+            steps = self._local_steps()
+            return steps[-1] if steps else None
         return self.manager.latest_step()
 
     def restore(self, state_like: TrainState, step: int | None = None) -> TrainState:
@@ -97,9 +178,13 @@ class Checkpointer:
         leaf is restored directly to its ``NamedSharding`` placement, no
         host-side detour (multi-host safe).
         """
-        step = step if step is not None else self.manager.latest_step()
+        step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found")
+        if self._local:
+            restored = self._local_restore(state_like, step)
+            logger.info("restored checkpoint at step %d (local npz)", step)
+            return restored
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
         restored = self.manager.restore(
             step,
@@ -109,6 +194,8 @@ class Checkpointer:
         return restored["state"]
 
     def wait_until_finished(self) -> None:
+        if self._local:
+            return
         self.manager.wait_until_finished()
 
     def restore_params_only(
@@ -119,9 +206,17 @@ class Checkpointer:
         ssl_default_config.yaml)."""
         import orbax.checkpoint as ocp
 
-        step = step if step is not None else self.manager.latest_step()
+        step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found")
+        if self._local:
+            params = self._local_restore(
+                state_like.params, step, subtree=".params"
+            )
+            logger.info(
+                "restored params-only checkpoint at step %d (local npz)", step
+            )
+            return state_like._replace(params=params)
         abstract = jax.tree.map(
             ocp.utils.to_shape_dtype_struct, state_like.params
         )
@@ -137,5 +232,7 @@ class Checkpointer:
         return state_like._replace(params=restored["state"]["params"])
 
     def close(self) -> None:
+        if self._local:
+            return
         self.manager.wait_until_finished()
         self.manager.close()

@@ -7,6 +7,7 @@ The working replacement for the reference's ``do_test`` stub
 
 from __future__ import annotations
 
+import functools
 import logging
 
 import numpy as np
@@ -51,6 +52,60 @@ def _loader(dataset_str, transform, batch_size, num_workers, seed,
     return loader, max_batches
 
 
+def _group_allgather(x: np.ndarray, mesh) -> np.ndarray:
+    """All-gather host arrays across exactly the processes owning ``mesh``'s
+    devices (a multidistillation subgroup) — a global
+    ``multihost_utils.process_allgather`` would be a collective the OTHER
+    groups never join, deadlocking the job (ADVICE r2, harness.py:92).
+
+    Mechanism: every process splits its rows over its own devices in the
+    mesh, assembles a global jax.Array over a flattened device list, and a
+    jit with replicated out_sharding performs the all-gather over only
+    those devices. Row order is the mesh's device order; padding rows (to
+    make local rows divide the local device count) are stripped via an
+    identically-gathered validity vector."""
+    import jax
+
+    devs = tuple(mesh.devices.reshape(-1))
+    local = [d for d in devs if d.process_index == jax.process_index()]
+    if len(local) == len(devs):  # single-process group: nothing to gather
+        return x
+    L = x.shape[0]
+    pad = (-L) % len(local)
+    valid = np.concatenate([np.ones(L, bool), np.zeros(pad, bool)])
+    if pad:
+        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+    per = x.shape[0] // len(local)
+    flat, sharded, replicate = _gather_program(devs)
+    gathered = []
+    for arr in (x, valid):
+        shards = [
+            jax.device_put(arr[i * per: (i + 1) * per], d)
+            for i, d in enumerate(local)
+        ]
+        ga = jax.make_array_from_single_device_arrays(
+            (per * len(devs),) + arr.shape[1:], sharded, shards
+        )
+        gathered.append(np.asarray(replicate(ga).addressable_data(0)))
+    out, mask = gathered
+    return out[mask]
+
+
+@functools.lru_cache(maxsize=8)
+def _gather_program(devs: tuple):
+    """One flat mesh + jitted replicating identity per device set — a fresh
+    jit object per call would pay a synchronized multi-host relowering for
+    every array of every eval period."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    flat = Mesh(np.array(devs), ("g",))
+    replicate = jax.jit(
+        lambda a: a, out_shardings=NamedSharding(flat, P(None))
+    )
+    return flat, NamedSharding(flat, P("g")), replicate
+
+
 def do_eval(
     cfg,
     model,
@@ -65,6 +120,9 @@ def do_eval(
     knn_k: int = 10,
     probe_epochs: int = 10,
     protocol: bool = False,
+    data_rank: int | None = None,
+    data_world: int | None = None,
+    mesh=None,
 ) -> dict:
     """Returns {"knn_top1": .., "linear_top1": ..} for the given backbone
     params (normally the EMA teacher's).
@@ -74,6 +132,11 @@ def do_eval(
     dinov3_tpu.evals``): pass ``max_*_samples=None`` for the FULL dataset,
     features extracted per host shard and allgathered, probes swept over
     the DINOv2 lr grid, k-NN at k=10 and 20.
+
+    Under multidistillation, ``do_train`` passes the subgroup's
+    ``data_rank``/``data_world`` and its ``mesh``: the loaders shard by
+    group rank (not global rank — mixing shards across different student
+    models), and the feature gather stays inside the group's devices.
     """
     ev = cfg.get("evaluation") or {}
     # same rooting rule as the train pipeline, so the eval sees the same
@@ -89,7 +152,8 @@ def do_eval(
     num_workers = cfg.train.get("num_workers", 8)
     import jax
 
-    rank, world = jax.process_index(), jax.process_count()
+    rank = data_rank if data_rank is not None else jax.process_index()
+    world = data_world if data_world is not None else jax.process_count()
 
     train_loader, train_batches = _loader(
         train_str,
@@ -116,13 +180,19 @@ def do_eval(
     if world > 1:
         # each host extracted its disjoint shard; the probe/knn need the
         # full feature matrix (features are tiny next to the images)
-        from jax.experimental import multihost_utils
+        if mesh is not None:
+            train_feats = _group_allgather(train_feats, mesh)
+            train_labels = _group_allgather(train_labels, mesh)
+            val_feats = _group_allgather(val_feats, mesh)
+            val_labels = _group_allgather(val_labels, mesh)
+        else:
+            from jax.experimental import multihost_utils
 
-        gather = multihost_utils.process_allgather
-        train_feats = np.concatenate(gather(train_feats))
-        train_labels = np.concatenate(gather(train_labels))
-        val_feats = np.concatenate(gather(val_feats))
-        val_labels = np.concatenate(gather(val_labels))
+            gather = multihost_utils.process_allgather
+            train_feats = np.concatenate(gather(train_feats))
+            train_labels = np.concatenate(gather(train_labels))
+            val_feats = np.concatenate(gather(val_feats))
+            val_labels = np.concatenate(gather(val_labels))
     n_classes = int(
         max(n_classes, train_labels.max() + 1, val_labels.max() + 1)
     )

@@ -201,11 +201,22 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     if resuming:
         state = ckpt.restore(state)
         if int(state.step) != start_iter:
+            # a partially-committed async save can be cleaned up between
+            # latest_step() and restore(); realign the data stream with
+            # the step actually restored instead of training on a stream
+            # advanced by the stale announced value (ADVICE r2)
             logger.warning(
-                "restored step %d != announced latest %d; data stream "
-                "advanced by the announced value", int(state.step), start_iter,
+                "restored step %d != announced latest %d; rebuilding the "
+                "data iterator at the restored step",
+                int(state.step), start_iter,
             )
-        start_iter = int(state.step)
+            start_iter = int(state.step)
+            data_iter = build_data_iterator(
+                cfg, B, rank=rank, world_size=world, start_iter=start_iter
+            )
+            first = next(data_iter)
+        else:
+            start_iter = int(state.step)
         logger.info("resumed at iteration %d", start_iter)
     elif cfg.distillation.enabled and cfg.distillation.checkpoint_path:
         from dinov3_tpu.train.distillation import load_teacher_params
@@ -332,6 +343,11 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
             results = do_eval(
                 cfg, setup.meta.teacher_backbone,
                 state.params["teacher"]["backbone"],
+                # subgroup-safe: shard eval data by the group's rank span
+                # and gather features over the group's devices only
+                # (ADVICE r2 — a global collective here deadlocks
+                # multidistillation groups with different schedules)
+                data_rank=rank, data_world=world, mesh=setup.mesh,
             )
             metric_logger.update(**results)
         stopping = preemption.should_stop()
@@ -387,6 +403,16 @@ def main(argv=None):
         # the producing op.
         jax.config.update("jax_debug_nans", True)
     cfg = load_config(args.config_file or None, overrides=list(args.opts))
+    if args.ref_losses and cfg.compute_precision.get("probs_dtype") != "fp32":
+        # golden comparisons run against fp32-reference loss traces; the
+        # recipe default bf16 probability storage would shift values past
+        # the comparator tolerance for reasons that are not bugs (ADVICE r2)
+        logger.warning(
+            "--ref-losses: pinning compute_precision.probs_dtype=fp32 "
+            "(was %s) for comparison against the fp32 reference trace",
+            cfg.compute_precision.get("probs_dtype"),
+        )
+        cfg.compute_precision.probs_dtype = "fp32"
     device = str((cfg.get("MODEL") or {}).get("DEVICE", "tpu") or "tpu")
     if device not in ("tpu", ""):
         # MODEL.DEVICE=cpu runs the trainer on the host backend (CPU smoke
