@@ -95,6 +95,7 @@ class Checkpointer:
 
     def _local_save(self, step: int, state: TrainState) -> bool:
         import os
+        import shutil
 
         import numpy as np
 
@@ -108,8 +109,6 @@ class Checkpointer:
         os.makedirs(tmp, exist_ok=True)
         np.savez(os.path.join(tmp, "state.npz"), **arrays)
         if os.path.isdir(final):  # overwrite-save of the same step
-            import shutil
-
             shutil.rmtree(final)
         os.rename(tmp, final)
         # retention: newest max_to_keep survive, plus every keep_every-th
@@ -117,8 +116,6 @@ class Checkpointer:
         for s in steps[: -self._max_to_keep or None]:
             if self._keep_every and s % self._keep_every == 0:
                 continue
-            import shutil
-
             shutil.rmtree(os.path.join(self._directory, str(s)),
                           ignore_errors=True)
         return True
@@ -140,8 +137,6 @@ class Checkpointer:
                     # npz stores ml_dtypes (bfloat16, fp8) as raw void
                     # records; the bytes are intact — reinterpret with the
                     # like-leaf's dtype
-                    import numpy as np
-
                     v = v.view(np.dtype(like.dtype))
                 if isinstance(like, jax.Array):
                     v = jax.device_put(v, like.sharding)

@@ -418,14 +418,18 @@ vit_giant2 = _ctor(1536, 40, 24, 4.0)
 vit_7b = _ctor(4096, 40, 32, 3.0)
 # tiny configs for tests/smoke runs (not in the reference ladder);
 # vit_test_big is a distinct-width "teacher" for distillation tests,
-# vit_test4 a 4-block stack for 4-stage pipeline validation
+# vit_test4 a 4-block stack for 4-stage pipeline validation,
+# vit_test40 the 7B *shape* skeleton (40 blocks, ffn_ratio 3.0 — same
+# depth/topology as vit_7b at test width) for stress dryruns
 vit_test = _ctor(64, 2, 2, 2.0)
 vit_test_big = _ctor(96, 3, 2, 2.0)
 vit_test4 = _ctor(64, 4, 2, 2.0)
+vit_test40 = _ctor(64, 40, 2, 3.0)
 
 ARCHS = {
     "vit_small": vit_small, "vit_base": vit_base, "vit_large": vit_large,
     "vit_so400m": vit_so400m, "vit_huge2": vit_huge2,
     "vit_giant2": vit_giant2, "vit_7b": vit_7b, "vit_test": vit_test,
     "vit_test_big": vit_test_big, "vit_test4": vit_test4,
+    "vit_test40": vit_test40,
 }

@@ -211,6 +211,10 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
                 int(state.step), start_iter,
             )
             start_iter = int(state.step)
+            if hasattr(data_iter, "close"):
+                # wind down the abandoned pipeline's prefetch threads
+                # (generator close propagates to the loader's finally)
+                data_iter.close()
             data_iter = build_data_iterator(
                 cfg, B, rank=rank, world_size=world, start_iter=start_iter
             )

@@ -93,6 +93,7 @@ def test_distillation_prototype_mismatch_rejected(tmp_path):
         SSLMetaArch(cfg)
 
 
+@pytest.mark.slow
 def test_load_teacher_params_from_checkpoint(tmp_path):
     """Pretrain a tiny teacher, checkpoint it, then restore it as the
     frozen teacher of a distillation run."""
@@ -174,6 +175,7 @@ def test_setup_multidistillation_assignment(tmp_path):
         setup_multidistillation(cfg, 0, 4, base_output_dir=str(tmp_path))
 
 
+@pytest.mark.slow
 def test_multidistillation_end_to_end_two_groups(tmp_path):
     """Two rank-span groups each train a *different* student arch
     end-to-end from one launch (reference spec:
@@ -286,8 +288,13 @@ def test_checkpointer_local_npz_backend(tmp_path):
     from dinov3_tpu.checkpoint import Checkpointer
     from dinov3_tpu.train.train_step import TrainState
 
-    ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=2)
-    ck._local = True  # force the subgroup backend in a 1-process test
+    ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=2, async_save=False)
+    # force the subgroup backend in a 1-process test (the production
+    # detection needs process_count > 1 and is covered by the 2-process
+    # multidistillation e2e); close the orbax manager it won't use
+    ck.manager.close()
+    ck.manager = None
+    ck._local = True
 
     def state_at(v):
         return TrainState(

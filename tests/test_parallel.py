@@ -119,3 +119,69 @@ def test_batch_sharding_divides_batch(eight_devices):
     y = jax.device_put(x, s)
     shard_shapes = {tuple(sh.data.shape) for sh in y.addressable_shards}
     assert shard_shapes == {(2, 4, 4, 3)}
+
+
+@pytest.mark.slow  # 61s: two 40-block compiles; tensor-axis collectives
+# stay covered in the default set by test_sharded_train_step (DPxFSDPxTP)
+def test_vocab_sharded_sinkhorn_7b_shapes(eight_devices):
+    """7B-shape stress (VERDICT r2 #6): 40 scanned blocks at embed 64 with
+    65536 prototypes sharded over the tensor axis. The Sinkhorn targets
+    normalize over a vocab-sharded [B, K] logits array (XLA inserts the
+    cross-tensor-axis reductions); the loss must match a replicated
+    single-device run to fp32 tolerance."""
+    proto = [
+        "student.arch=vit_test40", "student.patch_size=4",
+        "student.drop_path_rate=0.0", "student.layerscale=1.0e-5",
+        "train.scan_layers=true",
+        "crops.global_crops_size=16", "crops.local_crops_size=8",
+        "crops.local_crops_number=2",
+        "dino.head_n_prototypes=65536", "dino.head_hidden_dim=64",
+        "dino.head_bottleneck_dim=32",
+        "ibot.head_n_prototypes=65536", "ibot.head_hidden_dim=64",
+        "ibot.head_bottleneck_dim=32",
+        "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=4",
+        "optim.warmup_epochs=1", "compute_precision.compute_dtype=fp32",
+        "optim.scaling_rule=none",
+    ]
+    cfg8 = get_default_config()
+    apply_dot_overrides(cfg8, proto + [
+        "parallel.data=-1", "parallel.fsdp=2", "parallel.tensor=2",
+    ])
+    B = 4
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg8, B, seed=0).items()}
+    setup8 = build_train_setup(cfg8, batch, devices=eight_devices)
+    assert setup8.mesh.shape["tensor"] == 2
+
+    # the DINO-head prototype bank is actually vocab(tensor)-sharded
+    dino_head = setup8.state_shardings.params["student"]["dino_head"]
+    last = dino_head["prototypes"]
+    assert any(
+        "tensor" in (ax if isinstance(ax, tuple) else (ax,))
+        for s in jax.tree.leaves(last) for ax in s.spec if ax is not None
+    ), last
+
+    cfg1 = get_default_config()
+    apply_dot_overrides(cfg1, proto + ["parallel.data=1"])
+    setup1 = build_train_setup(cfg1, batch, devices=eight_devices[:1])
+
+    d8 = put_batch(batch, setup8.batch_shardings)
+    d1 = put_batch(batch, setup1.batch_shardings)
+    _, m8 = setup8.step_fn(setup8.state, d8, setup8.scalars(0),
+                           jax.random.key(0))
+    _, m1 = setup1.step_fn(setup1.state, d1, setup1.scalars(0),
+                           jax.random.key(0))
+    # the Sinkhorn-target-dependent losses are the subject: measured
+    # rel diff ~1e-7 across the vocab-sharded vs replicated runs
+    for key in ("dino_global_crops_loss", "dino_local_crops_loss",
+                "ibot_loss"):
+        np.testing.assert_allclose(
+            float(m8[key]), float(m1[key]), rtol=2e-4, err_msg=key
+        )
+    # koleo picks top-k nearest neighbors among near-identical init
+    # embeddings — reduction-order noise flips tie-breaks (measured
+    # ~0.9% rel) — so the total gets a loose bound only
+    np.testing.assert_allclose(
+        float(m8["total_loss"]), float(m1["total_loss"]), rtol=2e-2,
+        err_msg="total_loss",
+    )

@@ -3,6 +3,8 @@
 import os
 import signal
 
+import pytest
+
 from dinov3_tpu.run import PreemptionHandler, job_context
 
 
@@ -115,6 +117,7 @@ def test_local_launcher_fails_fast_on_child_error(tmp_path):
     assert time.monotonic() - t0 < 120
 
 
+@pytest.mark.slow
 def test_local_launcher_multiprocess_training(tmp_path):
     """Two coordinated processes form a data=2 mesh and train end-to-end —
     the multi-host path the reference stubbed out (its get_rank() was

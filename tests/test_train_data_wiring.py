@@ -96,6 +96,7 @@ def test_multires_folder_pipeline_resume(tmp_path):
         _same(want, got)
 
 
+@pytest.mark.slow
 def test_trainer_resume_continues_data_stream(tmp_path):
     """End-to-end: train 4 iters uninterrupted vs 2 iters + resume; the
     resumed run must see the same batches (identical per-step losses)."""
@@ -134,6 +135,7 @@ def test_trainer_resume_continues_data_stream(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_trainer_multires_recipe_reaches_step_fn(tmp_path):
     """A crop-size-list recipe (the vit7b16_high_res_adapt.yaml shape,
     scaled to vit_test) trains end-to-end on the synthetic backend, one jit

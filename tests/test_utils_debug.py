@@ -3,6 +3,8 @@
 
 import json
 
+import pytest
+
 import numpy as np
 
 from dinov3_tpu.utils import (
@@ -64,6 +66,7 @@ def test_dump_weights_flat_npz(tmp_path):
     np.testing.assert_array_equal(loaded["a/b"], np.ones((2, 2)))
 
 
+@pytest.mark.slow
 def test_trainer_record_compare_benchmark_flags(tmp_path):
     from dinov3_tpu.train.train import main
 
