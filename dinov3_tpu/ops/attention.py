@@ -89,7 +89,15 @@ def xla_attention(
         col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, N, N), 3)
         logits = jnp.where(col <= row, logits, jnp.asarray(-jnp.inf, logits.dtype))
     if probs_dtype is not None and probs_dtype != logits.dtype:
-        probs = _softmax_lowp(logits, probs_dtype)
+        import os
+
+        if os.environ.get("DINOV3_PLAIN_LOWP_SOFTMAX") == "1":
+            # bisect switch (BENCH_r02 compile-hang postmortem): same
+            # bf16 probability storage but plain autodiff — isolates the
+            # custom_vjp as the variable if the axon compile helper stalls
+            probs = jax.nn.softmax(logits, axis=-1).astype(probs_dtype)
+        else:
+            probs = _softmax_lowp(logits, probs_dtype)
     else:
         probs = jax.nn.softmax(logits, axis=-1)
     # named for the "attn" remat policy (ops/block.py remat_block_cls):

@@ -354,6 +354,15 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
                 data_rank=rank, data_world=world, mesh=setup.mesh,
             )
             metric_logger.update(**results)
+            if rank == 0:
+                # one clean record per eval (the meter JSONL smooths
+                # repeated values into running medians — useless for
+                # accuracy-trajectory artifacts)
+                import json as _json
+
+                with open(f"{cfg.train.output_dir}/evals.json", "a") as f:
+                    f.write(_json.dumps(
+                        {"iteration": it + 1, **results}) + "\n")
         stopping = preemption.should_stop()
         if (
             (it + 1) % cfg.checkpointing.period == 0

@@ -1,0 +1,80 @@
+#!/bin/bash
+# Round-3 TPU measurement queue — one pass captures everything the round
+# needs the moment the tunnel is healthy. Each phase is timeout-bounded
+# and appends a tagged line to $RESULTS, so a hang in any phase is
+# attributable (bench.py's stderr heartbeat names the stuck phase) and
+# never blocks the rest.
+#
+#   ph1  probs=fp32      round-1-equivalent step program: validates the
+#                        TPU path end-to-end and seeds the compile cache
+#   ph2  default (bf16 probs, custom-VJP softmax) — the round-2 program
+#                        the judge's bench run hung on
+#   ph3  bf16 probs, plain autodiff (DINOV3_PLAIN_LOWP_SOFTMAX=1) —
+#                        isolates the custom_vjp if ph2 stalls
+#   ph4  fused Pallas LayerNorm on top of the ph1/ph2 winner
+#   ph5  high-res flash-vs-XLA crossover (512px and 768px, auto vs xla)
+#
+# Usage: bash scripts/r3_tpu_queue.sh  (env: RESULTS, BENCH_* passthrough)
+
+set -u
+cd "$(dirname "$0")/.."
+RESULTS="${RESULTS:-/tmp/r3_tpu_results.jsonl}"
+LOG="${QUEUE_LOG:-/tmp/r3_tpu_queue.log}"
+
+note() { echo "[queue $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+run_bench() {
+    local tag="$1" tmo="$2"; shift 2
+    note "start $tag (timeout ${tmo}s) env: $*"
+    local out rc
+    out=$(env "$@" BENCH_INIT_RETRIES=2 timeout "$tmo" \
+          python bench.py 2>>"$LOG")
+    rc=$?
+    if [ $rc -eq 0 ] && [ -n "$out" ]; then
+        echo "{\"tag\": \"$tag\", \"rc\": 0, \"result\": $out}" >> "$RESULTS"
+        note "done  $tag -> $out"
+    else
+        echo "{\"tag\": \"$tag\", \"rc\": $rc, \"result\": null}" >> "$RESULTS"
+        note "FAIL  $tag rc=$rc (124=timeout: phase named in $LOG heartbeat)"
+    fi
+    return $rc
+}
+
+note "=== r3 TPU queue starting ==="
+
+# ph1: round-1-equivalent program (fp32 probs). Long timeout: cold
+# compile through the tunnel helper took 4-7 min in round 1. This is the
+# end-to-end validation gate: if the known-good program cannot produce a
+# number, the tunnel/helper is sick and the rest would only burn hours.
+run_bench ph1_probs_fp32 1500 BENCH_PROBS=fp32
+PH1=$?
+if [ $PH1 -ne 0 ]; then
+    note "ABORT: validation phase ph1 failed (rc=$PH1) — tunnel/helper unhealthy"
+    exit 1
+fi
+
+# ph2: the round-2 default program (bf16 probs custom-VJP)
+run_bench ph2_probs_bf16_customvjp 2100
+PH2=$?
+
+# ph3: only informative if ph2 stalled — bf16 storage, plain autodiff
+if [ $PH2 -ne 0 ]; then
+    run_bench ph3_probs_bf16_plain 2100 DINOV3_PLAIN_LOWP_SOFTMAX=1
+fi
+
+# ph4: fused Pallas LayerNorm on top of the best stable program
+if [ $PH2 -eq 0 ]; then
+    run_bench ph4_fused_ln 2100 DINOV3_FUSED_LN=1
+else
+    run_bench ph4_fused_ln_fp32probs 2100 DINOV3_FUSED_LN=1 BENCH_PROBS=fp32
+fi
+
+# ph5: high-res crossover table (flash auto vs dense xla)
+run_bench ph5_hr512_auto 2100 BENCH_RES=512 BENCH_BATCH=2
+run_bench ph5_hr512_xla  2100 BENCH_RES=512 BENCH_BATCH=2 \
+    BENCH_OVERRIDES=kernels.flash_attention=xla
+run_bench ph5_hr768_auto 2400 BENCH_RES=768 BENCH_BATCH=1
+run_bench ph5_hr768_xla  2400 BENCH_RES=768 BENCH_BATCH=1 \
+    BENCH_OVERRIDES=kernels.flash_attention=xla
+
+note "=== r3 TPU queue complete; results in $RESULTS ==="
