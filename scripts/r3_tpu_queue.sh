@@ -24,11 +24,16 @@ LOG="${QUEUE_LOG:-/tmp/r3_tpu_queue.log}"
 note() { echo "[queue $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 
 run_bench() {
+    # The per-attempt bound lives in bench.py's supervisor (it kills the
+    # whole child process GROUP — a shell `timeout` here would SIGTERM
+    # only the supervisor and orphan a hung compile still holding the
+    # tunnel). The outer timeout is a belt-and-braces backstop sized
+    # above the supervisor's worst case (2 attempts x tmo).
     local tag="$1" tmo="$2"; shift 2
-    note "start $tag (timeout ${tmo}s) env: $*"
+    note "start $tag (attempt timeout ${tmo}s) env: $*"
     local out rc
-    out=$(env "$@" BENCH_INIT_RETRIES=2 timeout "$tmo" \
-          python bench.py 2>>"$LOG")
+    out=$(env "$@" BENCH_ATTEMPT_TIMEOUT="$tmo" \
+          timeout $((2 * tmo + 300)) python bench.py 2>>"$LOG")
     rc=$?
     if [ $rc -eq 0 ] && [ -n "$out" ]; then
         echo "{\"tag\": \"$tag\", \"rc\": 0, \"result\": $out}" >> "$RESULTS"
@@ -76,5 +81,26 @@ run_bench ph5_hr512_xla  2100 BENCH_RES=512 BENCH_BATCH=2 \
 run_bench ph5_hr768_auto 2400 BENCH_RES=768 BENCH_BATCH=1
 run_bench ph5_hr768_xla  2400 BENCH_RES=768 BENCH_BATCH=1 \
     BENCH_OVERRIDES=kernels.flash_attention=xla
+
+# ph6: committed-evidence profile of the default step program (device
+# time breakdown by op category; compile cache makes this cheap now)
+note "start ph6_profile"
+if timeout 1800 python scripts/profile_step.py /tmp/prof_r3 \
+        >> "$LOG" 2>&1; then
+    note "done  ph6_profile -> /tmp/prof_r3"
+else
+    note "FAIL  ph6_profile rc=$?"
+fi
+
+# ph7: ViT-S accuracy trajectory on the real chip (digits folder backend,
+# a few thousand steps, evals every 500) — the VERDICT r2 #4 shape
+note "start ph7_tpu_trajectory"
+if TRAJ_STEPS=3000 TRAJ_EVAL_EVERY=500 TRAJ_ARCH=vit_small TRAJ_BATCH=64 \
+        timeout 7200 python scripts/train_trajectory.py /tmp/traj_tpu \
+        >> "$LOG" 2>&1; then
+    note "done  ph7_tpu_trajectory -> /tmp/traj_tpu/TRAJECTORY.json"
+else
+    note "FAIL  ph7_tpu_trajectory rc=$?"
+fi
 
 note "=== r3 TPU queue complete; results in $RESULTS ==="
