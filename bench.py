@@ -172,27 +172,45 @@ def _split_overrides(s: str) -> list[str]:
     return out
 
 
-def _run_attempt(env: dict, tmo: float):
+_CURRENT_CHILD = {"proc": None}
+
+
+def _killpg_child(proc) -> None:
+    import signal
+
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def _run_attempt(env: dict, tmo: float, argv: list | None = None):
     """One measurement child in its own process group (a hung axon
     compile survives SIGTERM-to-parent; killpg reaps the probe/compile
-    grandchildren too). Returns (rc, stdout) with rc=124 on timeout."""
-    import signal
+    grandchildren too). Returns (rc, stdout) with rc=124 on timeout.
+    ``argv`` overrides the child program (tests drive this code path
+    with their own victim process)."""
     import subprocess
 
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__)],
+        argv or [sys.executable, os.path.abspath(__file__)],
         env=env, stdout=subprocess.PIPE, text=True, start_new_session=True,
     )
+    _CURRENT_CHILD["proc"] = proc
     try:
         out, _ = proc.communicate(timeout=tmo)
         return proc.returncode, out or ""
     except subprocess.TimeoutExpired:
+        _killpg_child(proc)
         try:
-            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
+            # bounded: a stray process that escaped the group into a new
+            # session could still hold the stdout pipe open
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
             pass
-        proc.communicate()
         return 124, ""
+    finally:
+        _CURRENT_CHILD["proc"] = None
 
 
 def _supervise() -> int:
@@ -202,16 +220,32 @@ def _supervise() -> int:
     in-process hung compile cannot be bounded), fall back once to the
     known-good fp32-probs program so the round still gets a TPU number.
 
-    Attribution matters: a child that FAILS (rc!=124, e.g. backend init
-    down after its fast retries) is an infrastructure problem, and the
-    fallback result is NOT labeled as a program timeout."""
+    Attribution matters: a fallback result is ALWAYS labeled as the
+    fp32-probs program (never silently substituted), with the reason the
+    default attempt ended (timeout vs rc)."""
+    import signal
+
+    # the queue's backstop `timeout` SIGTERMs this supervisor: reap the
+    # child group on the way out instead of orphaning a hung compile
+    # that would hold the tunnel for every later phase
+    def _on_term(signum, frame):
+        proc = _CURRENT_CHILD["proc"]
+        if proc is not None:
+            _killpg_child(proc)
+        sys.exit(143)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
     attempts = [{}, {"BENCH_PROBS": "fp32"}]
-    if os.environ.get("BENCH_PROBS") or os.environ.get("BENCH_OVERRIDES"):
-        # caller pinned the program (bisect/sweep run): no silent
-        # program substitution, just one bounded attempt
+    pinned = ("BENCH_PROBS", "BENCH_OVERRIDES", "BENCH_RES", "BENCH_ARCH",
+              "DINOV3_PLAIN_LOWP_SOFTMAX", "DINOV3_FUSED_LN")
+    if any(os.environ.get(k) for k in pinned):
+        # caller pinned the program (bisect/sweep/crossover run): a
+        # substituted program would invalidate the comparison — one
+        # bounded attempt, no fallback
         attempts = [{}]
     tmo = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "2700"))
-    default_timed_out = False
+    default_failed_how = None
     for i, extra in enumerate(attempts):
         env = dict(os.environ, BENCH_SUPERVISE="0", **extra)
         # infra failures must surface fast (rc=2) instead of eating the
@@ -224,22 +258,26 @@ def _supervise() -> int:
             _log(f"supervisor: attempt {i + 1} timed out after {tmo:.0f}s "
                  "(stuck phase named in the heartbeat above); "
                  "process group killed")
-            if i == 0 and not extra:
-                default_timed_out = True
+            if i == 0:
+                default_failed_how = f"timed out after {tmo:.0f}s"
             continue
         if rc == 0 and out.strip():
             line = out.strip().splitlines()[-1]
-            if extra and default_timed_out:
+            if extra:
                 try:
                     rec = json.loads(line)
-                    rec["fallback"] = \
-                        "fp32-probs program (default program timed out)"
+                    rec["fallback"] = (
+                        "fp32-probs program (default program "
+                        f"{default_failed_how})"
+                    )
                     line = json.dumps(rec)
                 except ValueError:
                     pass  # forward the raw line rather than die on it
             print(line)
             return 0
         _log(f"supervisor: attempt {i + 1} failed rc={rc}")
+        if i == 0:
+            default_failed_how = f"failed rc={rc}"
     _log("supervisor: all attempts failed")
     return 2
 
