@@ -416,13 +416,17 @@ def main(argv=None):
         # the producing op.
         jax.config.update("jax_debug_nans", True)
     cfg = load_config(args.config_file or None, overrides=list(args.opts))
-    if args.ref_losses and cfg.compute_precision.get("probs_dtype") != "fp32":
-        # golden comparisons run against fp32-reference loss traces; the
-        # recipe default bf16 probability storage would shift values past
-        # the comparator tolerance for reasons that are not bugs (ADVICE r2)
+    if (args.ref_losses or args.record_losses) \
+            and cfg.compute_precision.get("probs_dtype") != "fp32":
+        # golden traces are recorded AND compared at fp32 probability
+        # storage: the recipe default bf16 would shift values past the
+        # comparator tolerance for reasons that are not bugs, and a
+        # recording must use the same program its comparison will
+        # (ADVICE r2)
         logger.warning(
-            "--ref-losses: pinning compute_precision.probs_dtype=fp32 "
-            "(was %s) for comparison against the fp32 reference trace",
+            "--record-losses/--ref-losses: pinning "
+            "compute_precision.probs_dtype=fp32 (was %s) so golden "
+            "traces are recorded and compared on the same fp32 program",
             cfg.compute_precision.get("probs_dtype"),
         )
         cfg.compute_precision.probs_dtype = "fp32"
