@@ -20,6 +20,17 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_default_matmul_precision", "highest")
+# the suite is compile-dominated; persist compiles across runs (keyed by
+# compiler fingerprint, so a jaxlib upgrade invalidates cleanly). Per-uid
+# path: a world-shared one turns into silent permission-denied no-ops for
+# the second user on a shared host
+import tempfile
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(tempfile.gettempdir(), f"jaxcache_cpu_tests_{os.getuid()}"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

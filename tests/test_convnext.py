@@ -60,6 +60,7 @@ def test_get_intermediate_layers(rng):
     assert tokens.shape[0] == 2 and tokens.shape[-1] == 64
 
 
+@pytest.mark.slow
 def test_convnext_ssl_train_step():
     """ConvNeXt student through the full fused SSL step (distillation-style:
     no iBOT token masking inside the convnet)."""

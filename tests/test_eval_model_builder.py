@@ -1,5 +1,6 @@
 """build_model_for_eval: fresh init and checkpoint-restored teacher."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -31,6 +32,7 @@ def test_eval_build_fresh():
     assert out["x_norm_clstoken"].shape == (1, 64)
 
 
+@pytest.mark.slow
 def test_eval_build_from_checkpoint(tmp_path):
     from dinov3_tpu.checkpoint import Checkpointer
     from dinov3_tpu.data import make_synthetic_batch

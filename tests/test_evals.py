@@ -104,6 +104,7 @@ def test_knn_eval_multi_ks():
     assert max(res.values()) > 0.9
 
 
+@pytest.mark.slow
 def test_standalone_eval_cli(tmp_path):
     """python -m dinov3_tpu.evals --ckpt ... runs the full protocol path
     (sweep + multi-k) against a trained checkpoint, standalone

@@ -2,6 +2,7 @@
 student.resume_from_teacher_chkpt — keys the reference declared but never
 wired)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -66,6 +67,7 @@ def test_pretrained_weights_warm_start(tmp_path):
     np.testing.assert_allclose(t, s)
 
 
+@pytest.mark.slow
 def test_resume_from_teacher_chkpt_loads_ema_branch(tmp_path):
     cfg, trained = _pretrain_and_save(tmp_path)
     cfg2 = get_default_config()
@@ -93,6 +95,7 @@ def test_no_keys_is_identity(tmp_path):
         cfg, setup.state, setup.state_shardings) is setup.state
 
 
+@pytest.mark.slow
 def test_partial_warm_start_with_mismatched_heads(tmp_path):
     cfg, trained = _pretrain_and_save(tmp_path)
     cfg2 = get_default_config()
