@@ -28,11 +28,21 @@ from dinov3_tpu.models.vision_transformer import (
 from dinov3_tpu.ops.common import Policy
 
 
+def _validated_drop_path_mode(s) -> str:
+    mode = str(s.get("drop_path_mode", "subset") or "subset")
+    if mode not in ("subset", "mask"):
+        raise ValueError(
+            f"student.drop_path_mode={mode!r}: expected subset|mask"
+        )
+    return mode
+
+
 def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
     s = cfg.student
     kw = dict(
         patch_size=s.patch_size,
         drop_path_rate=0.0 if teacher else s.drop_path_rate,
+        drop_path_mode=_validated_drop_path_mode(s),
         layerscale_init=s.layerscale,
         ffn_layer=s.ffn_layer,
         moe_num_experts=int(s.get("moe_num_experts", 8) or 8),

@@ -76,6 +76,7 @@ class DinoVisionTransformer(nn.Module):
     proj_bias: bool = True
     ffn_bias: bool = True
     drop_path_rate: float = 0.0
+    drop_path_mode: str = "subset"  # subset (reference semantics) | mask
     layerscale_init: float | None = None
     norm_layer: str = "layernorm"
     ffn_layer: str = "mlp"
@@ -191,6 +192,7 @@ class DinoVisionTransformer(nn.Module):
             norm_layer=self.norm_layer, qkv_bias=self.qkv_bias,
             proj_bias=self.proj_bias, ffn_bias=self.ffn_bias,
             drop_path_rate=self.drop_path_rate,
+            drop_path_mode=self.drop_path_mode,
             layerscale_init=self.layerscale_init,
             mask_k_bias=self.mask_k_bias, attn_impl=self.attn_impl,
             flash_block_q=self.flash_block_q,

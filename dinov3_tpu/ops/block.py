@@ -1,8 +1,9 @@
-"""Pre-norm transformer block with LayerScale and per-sample drop-path.
+"""Pre-norm transformer block with LayerScale and stochastic depth.
 
-(reference: dinov3_jax/layers/block.py — whose list-forward/stochastic-depth
-subset indexing is replaced by static-shape per-sample masking; multi-crop
-lists are handled at the model level by batching same-resolution crops.)
+(reference: dinov3_jax/layers/block.py — its list-forward is replaced by
+model-level batching of same-resolution crops; its stochastic-depth batch
+subsetting is kept as ``drop_path_mode="subset"``, made TPU-static via a
+fixed ``floor(B*(1-rate))`` keep count — see ops/drop_path.py.)
 """
 
 from __future__ import annotations
@@ -13,7 +14,11 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from dinov3_tpu.ops.attention import SelfAttention
-from dinov3_tpu.ops.drop_path import DropPath
+from dinov3_tpu.ops.drop_path import (
+    DropPath,
+    subset_keep_count,
+    subset_residual,
+)
 from dinov3_tpu.ops.ffn import make_ffn_layer
 from dinov3_tpu.ops.layer_scale import LayerScale
 from dinov3_tpu.ops.norms import make_norm_layer
@@ -29,6 +34,7 @@ class SelfAttentionBlock(nn.Module):
     proj_bias: bool = True
     ffn_bias: bool = True
     drop_path_rate: float = 0.0
+    drop_path_mode: str = "subset"  # subset (reference semantics) | mask
     layerscale_init: float | None = 1e-5
     mask_k_bias: bool = False
     attn_impl: str = "auto"
@@ -57,9 +63,9 @@ class SelfAttentionBlock(nn.Module):
             if self.layerscale_init is not None
             else (lambda name: (lambda y: y))
         )
-        dp = DropPath(self.drop_path_rate)
-
-        attn_out = SelfAttention(
+        norm1 = make_norm_layer(self.norm_layer, name="norm1", **norm_kw)
+        norm2 = make_norm_layer(self.norm_layer, name="norm2", **norm_kw)
+        attn = SelfAttention(
             dim=self.dim, num_heads=self.num_heads, qkv_bias=self.qkv_bias,
             proj_bias=self.proj_bias, mask_k_bias=self.mask_k_bias,
             attn_impl=self.attn_impl, seq_parallel=self.seq_parallel,
@@ -69,18 +75,58 @@ class SelfAttentionBlock(nn.Module):
             param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
             probs_dtype=self.probs_dtype,
             name="attn",
-        )(make_norm_layer(self.norm_layer, name="norm1", **norm_kw)(x),
-          rope=rope, deterministic=deterministic)
-        x = x + dp(ls("ls1")(attn_out), deterministic=deterministic)
-
-        ffn_out = make_ffn_layer(
+        )
+        mlp = make_ffn_layer(
             self.ffn_layer, int(self.dim * self.ffn_ratio),
             moe_num_experts=self.moe_num_experts, moe_top_k=self.moe_top_k,
             use_bias=self.ffn_bias, fp8=self.fp8, dtype=self.dtype,
             param_dtype=self.param_dtype, name="mlp",
-        )(make_norm_layer(self.norm_layer, name="norm2", **norm_kw)(x),
-          deterministic=deterministic)
-        x = x + dp(ls("ls2")(ffn_out), deterministic=deterministic)
+        )
+
+        def attn_branch(t):
+            return ls("ls1")(attn(norm1(t), rope=rope,
+                                  deterministic=deterministic))
+
+        def mlp_branch(t):
+            return ls("ls2")(mlp(norm2(t), deterministic=deterministic))
+
+        if self.drop_path_mode not in ("subset", "mask"):
+            raise ValueError(
+                f"unknown drop_path_mode {self.drop_path_mode!r}; "
+                "expected subset|mask"
+            )
+        dropping = self.drop_path_rate > 0.0 and not deterministic
+        use_subset = dropping and self.drop_path_mode == "subset"
+        if use_subset:
+            # stratify by the data-shard count: per-span sampling matches
+            # the torch reference's per-rank subsetting and keeps the
+            # sampled rows inside each shard's span (subset_residual doc)
+            from dinov3_tpu.parallel.context import get_current_mesh
+            from dinov3_tpu.parallel.mesh import data_parallel_size
+
+            mesh = get_current_mesh()
+            B = x.shape[0]
+            G = data_parallel_size(mesh) if mesh is not None else 1
+            groups = G if (G > 1 and B % G == 0) else 1
+            if subset_keep_count(B // groups, self.drop_path_rate) >= B // groups:
+                # batch too small for the rate (e.g. single-row pipeline
+                # microbatches): subsetting would silently disable drop
+                # path — fall back to the per-sample mask for this call
+                use_subset = False
+        if use_subset:
+            # reference semantics (block.py:94-117): the branch runs on a
+            # random floor(B*(1-rate)) subset — dropped samples skip the
+            # compute, not just the residual
+            x = subset_residual(x, attn_branch,
+                                self.make_rng("drop_path"),
+                                self.drop_path_rate, groups=groups)
+            x = subset_residual(x, mlp_branch,
+                                self.make_rng("drop_path"),
+                                self.drop_path_rate, groups=groups)
+        else:
+            dp = DropPath(self.drop_path_rate)
+            x = x + dp(attn_branch(x), deterministic=deterministic)
+            x = x + dp(mlp_branch(x), deterministic=deterministic)
         return x
 
 def remat_block_cls(remat: str):

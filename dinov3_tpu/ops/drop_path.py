@@ -1,19 +1,85 @@
-"""Per-sample stochastic depth.
+"""Stochastic depth, two TPU-static flavors.
 
-Replaces the reference's data-dependent batch-subset indexing trick
-(dinov3_jax/layers/block.py:94-117) — which cannot be jitted with static
-shapes on TPU — with the standard per-sample Bernoulli residual mask
-(same expectation, fully static shapes; SURVEY.md §7.3).
+The reference implements drop-path by *batch subsetting* — it computes the
+residual branch on a random ``floor(B*(1-rate))``-row subset and
+scatter-adds the scaled result back (dinov3_jax/layers/block.py:94-117), so
+dropped samples skip the branch compute entirely. That is the semantic the
+published throughput anchors were measured with: at ``drop_path_rate=0.3``
+it skips ~31% of every student block's FLOPs.
+
+On TPU the subset size must be static for XLA; it is — ``B`` and ``rate``
+are trace-time constants — so ``subset_residual`` keeps the reference's
+compute-skipping semantics with fully static shapes (sorted gather →
+branch on [keep, ...] → scatter-add). The per-sample Bernoulli mask
+(``DropPath``) is kept as the ``drop_path_mode="mask"`` fallback: same
+expectation, no gather/scatter, but full branch compute.
 """
 
 from __future__ import annotations
+
+from typing import Callable
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 
+def subset_keep_count(batch: int, rate: float) -> int:
+    """floor(B * (1 - rate)), at least 1 (reference block.py:88-91)."""
+    return max(1, int(batch * (1.0 - rate)))
+
+
+def subset_residual(
+    x: jnp.ndarray,
+    branch: Callable[[jnp.ndarray], jnp.ndarray],
+    rng: jax.Array,
+    rate: float,
+    groups: int = 1,
+) -> jnp.ndarray:
+    """x + drop-path(branch) with the reference's batch-subset semantics.
+
+    Computes ``branch`` on a random ``keep``-row subset of ``x`` (static
+    shape) and scatter-adds ``B/keep``-scaled results back, leaving the
+    other rows' residuals dropped. Indices are sorted so the gather and
+    scatter are monotone row selections, the cheapest form on TPU.
+
+    ``groups > 1`` stratifies the sampling: the batch is treated as
+    ``groups`` contiguous row spans and ``floor((B/groups)*(1-rate))``
+    rows are drawn *within each span*. With groups = the data-shard count
+    this matches the torch reference's per-rank subsetting (each FSDP
+    rank permuted its local batch) and keeps every sampled index inside
+    its span — equal work per shard, and the gather never has to reach
+    into another span except through XLA's own partitioning choices.
+    """
+    B = x.shape[0]
+    if groups < 1 or B % groups:
+        raise ValueError(f"groups={groups} must divide batch {B}")
+    Bg = B // groups
+    keep_g = subset_keep_count(Bg, rate)
+    if keep_g >= Bg:
+        return x + branch(x).astype(x.dtype)
+    if groups == 1:
+        idx = jnp.sort(jax.random.permutation(rng, B)[:keep_g])
+    else:
+        perms = jax.vmap(
+            lambda k: jax.random.permutation(k, Bg)[:keep_g]
+        )(jax.random.split(rng, groups))
+        offs = (jnp.arange(groups, dtype=perms.dtype) * Bg)[:, None]
+        # sorted within each span; spans are in ascending offset order,
+        # so the flattened index vector is globally sorted
+        idx = jnp.sort(perms, axis=1).reshape(-1) + offs.reshape(-1).repeat(keep_g)
+    xs = jnp.take(x, idx, axis=0, unique_indices=True,
+                  indices_are_sorted=True)
+    res = branch(xs) * (Bg / keep_g)
+    return x.at[idx].add(res.astype(x.dtype), indices_are_sorted=True,
+                         unique_indices=True, mode="promise_in_bounds")
+
+
 class DropPath(nn.Module):
+    """Per-sample Bernoulli residual mask (``drop_path_mode="mask"``):
+    same expectation as the subset form, static shapes, but the branch is
+    computed for every sample and masked after the fact."""
+
     rate: float = 0.0
 
     @nn.compact

@@ -1,0 +1,105 @@
+#!/bin/bash
+# Round-3b TPU measurement queue — probe-gated successor of r3_tpu_queue.sh.
+#
+# Lesson from the first r3 pass: killing a hung remote compile wedges the
+# axon tunnel for a long time (every later backend init hangs in the
+# probe). So this queue (a) waits for a HEALTHY probe before every phase
+# rather than burning each phase's timeout against a dead tunnel, and
+# (b) orders the wedge-prone giant compiles (high-res flash) last.
+#
+#   phA  default program — now includes reference-semantics subset
+#        drop-path (student.drop_path_mode=subset): the headline number
+#   phB  drop_path_mode=mask A/B — isolates the subset win
+#   phC  batch sweep at B=10 and B=12 (the FLOP cut may shift the peak)
+#   phD  profile of the default step program (committed-evidence artifact)
+#   phE  TPU accuracy trajectory (ViT-S, 3000 steps)
+#   phF  high-res crossover (512/768px, flash auto vs dense xla)
+#
+# Usage: bash scripts/r3b_queue.sh   (env: RESULTS, DEADLINE_HOURS)
+
+set -u
+cd "$(dirname "$0")/.."
+RESULTS="${RESULTS:-/tmp/r3b_results.jsonl}"
+LOG="${QUEUE_LOG:-/tmp/r3b_queue.log}"
+DEADLINE=$(( $(date +%s) + ${DEADLINE_HOURS:-9} * 3600 ))
+
+note() { echo "[r3b $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+probe() {
+    timeout 300 python - <<'EOF' >>"$LOG" 2>&1
+import sys
+sys.path.insert(0, ".")
+from dinov3_tpu.utils import respect_jax_platforms_env
+respect_jax_platforms_env()
+import jax
+assert jax.default_backend() != "cpu", "fell back to cpu"
+print("PROBE-OK", jax.device_count())
+EOF
+}
+
+wait_healthy() {
+    while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+        if probe; then note "probe healthy"; return 0; fi
+        note "probe unhealthy; sleeping 240s"
+        sleep 240
+    done
+    note "deadline reached while waiting for a healthy tunnel"
+    return 1
+}
+
+run_bench() {
+    local tag="$1" tmo="$2"; shift 2
+    wait_healthy || return 1
+    note "start $tag (attempt timeout ${tmo}s) env: $*"
+    local out rc
+    out=$(env "$@" BENCH_ATTEMPT_TIMEOUT="$tmo" \
+          timeout $((tmo + 600)) python bench.py 2>>"$LOG")
+    rc=$?
+    if [ $rc -eq 0 ] && [ -n "$out" ]; then
+        echo "{\"tag\": \"$tag\", \"rc\": 0, \"result\": $out}" >> "$RESULTS"
+        note "done  $tag -> $out"
+    else
+        echo "{\"tag\": \"$tag\", \"rc\": $rc, \"result\": null}" >> "$RESULTS"
+        note "FAIL  $tag rc=$rc"
+    fi
+    return $rc
+}
+
+note "=== r3b queue starting; deadline $(date -d @$DEADLINE +%H:%M:%S) ==="
+
+run_bench phA_subset_default 2100
+run_bench phB_mask_ab        2100 BENCH_OVERRIDES=student.drop_path_mode=mask
+run_bench phC_b10            2100 BENCH_BATCH=10
+run_bench phC_b12            2100 BENCH_BATCH=12
+
+wait_healthy && {
+    note "start phD_profile"
+    if timeout 2400 python scripts/profile_step.py /tmp/prof_r3 \
+            >> "$LOG" 2>&1; then
+        note "done  phD_profile -> /tmp/prof_r3"
+    else
+        note "FAIL  phD_profile rc=$?"
+    fi
+}
+
+wait_healthy && {
+    note "start phE_tpu_trajectory"
+    if TRAJ_STEPS=3000 TRAJ_EVAL_EVERY=500 TRAJ_ARCH=vit_small TRAJ_BATCH=64 \
+            timeout 7200 python scripts/train_trajectory.py /tmp/traj_tpu \
+            >> "$LOG" 2>&1; then
+        note "done  phE_tpu_trajectory -> /tmp/traj_tpu/TRAJECTORY.json"
+    else
+        note "FAIL  phE_tpu_trajectory rc=$?"
+    fi
+}
+
+# wedge-prone giant compiles last; generous timeouts (the 512px flash
+# fwd+bwd compile exceeded 35 min through the tunnel helper)
+run_bench phF_hr512_auto 3600 BENCH_RES=512 BENCH_BATCH=2
+run_bench phF_hr512_xla  3600 BENCH_RES=512 BENCH_BATCH=2 \
+    BENCH_OVERRIDES=kernels.flash_attention=xla
+run_bench phF_hr768_auto 3900 BENCH_RES=768 BENCH_BATCH=1
+run_bench phF_hr768_xla  3900 BENCH_RES=768 BENCH_BATCH=1 \
+    BENCH_OVERRIDES=kernels.flash_attention=xla
+
+note "=== r3b queue complete; results in $RESULTS ==="

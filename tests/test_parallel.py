@@ -185,3 +185,30 @@ def test_vocab_sharded_sinkhorn_7b_shapes(eight_devices):
         float(m8["total_loss"]), float(m1["total_loss"]), rtol=2e-2,
         err_msg="total_loss",
     )
+
+
+def test_sharded_train_step_subset_drop_path(eight_devices):
+    """Reference-style batch-subset drop path (gather -> branch -> scatter)
+    must stay legal under a data-sharded GSPMD mesh: the per-block gather
+    with traced indices partitions (or falls back to a collective), and
+    the step still runs and learns finitely."""
+    cfg = smol_cfg([
+        "parallel.data=-1", "parallel.fsdp=2",
+        "student.drop_path_rate=0.5", "student.drop_path_mode=subset",
+    ])
+    # data_parallel_size = data(4) x fsdp(2) = 8 -> groups=8; B=16 gives
+    # Bg=2, keep_g=1 < Bg, so the subset gather/scatter path is actually
+    # traced under the sharded mesh (B=8 would fall back to mask mode)
+    B = 16
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, B, seed=0).items()}
+    setup = build_train_setup(cfg, batch, devices=eight_devices)
+    dbatch = put_batch(batch, setup.batch_shardings)
+    state, metrics = setup.step_fn(
+        setup.state, dbatch, setup.scalars(0), jax.random.key(0)
+    )
+    assert np.isfinite(float(metrics["total_loss"]))
+    state, metrics2 = setup.step_fn(
+        state, dbatch, setup.scalars(1), jax.random.key(0)
+    )
+    assert np.isfinite(float(metrics2["total_loss"]))
