@@ -25,8 +25,9 @@ compile hang cost the round its evidence):
   BENCH_OVERRIDES=comma-separated extra dot-overrides.
 
 Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 8 — the
-throughput peak on a 16G v5e: measured 54.4 img/s at B=6, 58.9 at B=8,
-57.6 at B=10, 54.1 at B=12, 52.9 at B=16; remat variants are net slower),
+round-1 sweep's peak; those sweeps ran with bf16 masters, so the absolute
+numbers are ~20% optimistic vs today's fp32-master program — see
+MEASUREMENTS_r3.md; the B=10/B=12 re-sweep is queued in r3b_queue.sh),
 BENCH_STEPS (10), BENCH_WARMUP (3), BENCH_RES (high-res crop px).
 """
 

@@ -11,9 +11,11 @@
 #        drop-path (student.drop_path_mode=subset): the headline number
 #   phB  drop_path_mode=mask A/B — isolates the subset win
 #   phC  batch sweep at B=10 and B=12 (the FLOP cut may shift the peak)
+#   phG  op-level flash-vs-dense attention crossover (fast compiles;
+#        runs before the wedge-prone phases so its evidence survives)
 #   phD  profile of the default step program (committed-evidence artifact)
 #   phE  TPU accuracy trajectory (ViT-S, 3000 steps)
-#   phF  high-res crossover (512/768px, flash auto vs dense xla)
+#   phF  full-step high-res crossover (512/768px, flash auto vs xla)
 #
 # Usage: bash scripts/r3b_queue.sh   (env: RESULTS, DEADLINE_HOURS)
 
@@ -71,6 +73,17 @@ run_bench phA_subset_default 2100
 run_bench phB_mask_ab        2100 BENCH_OVERRIDES=student.drop_path_mode=mask
 run_bench phC_b10            2100 BENCH_BATCH=10
 run_bench phC_b12            2100 BENCH_BATCH=12
+
+
+wait_healthy && {
+    note "start phG_attn_crossover"
+    if timeout 2400 python scripts/bench_attention_crossover.py \
+            /tmp/attn_crossover.jsonl >> "$LOG" 2>&1; then
+        note "done  phG_attn_crossover -> /tmp/attn_crossover.jsonl"
+    else
+        note "FAIL  phG_attn_crossover rc=$?"
+    fi
+}
 
 wait_healthy && {
     note "start phD_profile"
