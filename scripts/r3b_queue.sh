@@ -11,11 +11,12 @@
 #        drop-path (student.drop_path_mode=subset): the headline number
 #   phB  drop_path_mode=mask A/B — isolates the subset win
 #   phC  batch sweep at B=10 and B=12 (the FLOP cut may shift the peak)
-#   phG  op-level flash-vs-dense attention crossover (fast compiles;
-#        runs before the wedge-prone phases so its evidence survives)
+#   phG  op-level flash-vs-dense attention crossover (fast compiles)
 #   phD  profile of the default step program (committed-evidence artifact)
-#   phE  TPU accuracy trajectory (ViT-S, 3000 steps)
-#   phF  full-step high-res crossover (512/768px, flash auto vs xla)
+#   phH  fp32-master ViT-S/B ladder points (small, safe compiles)
+#   phF  full-step high-res crossover (512/768px) — wedge-prone giant
+#        compiles, after everything cheap
+#   phE  TPU accuracy trajectory (ViT-S, 3000 steps) — last, 2h
 #
 # Usage: bash scripts/r3b_queue.sh   (env: RESULTS, DEADLINE_HOURS)
 
@@ -74,7 +75,6 @@ run_bench phB_mask_ab        2100 BENCH_OVERRIDES=student.drop_path_mode=mask
 run_bench phC_b10            2100 BENCH_BATCH=10
 run_bench phC_b12            2100 BENCH_BATCH=12
 
-
 wait_healthy && {
     note "start phG_attn_crossover"
     if timeout 2400 python scripts/bench_attention_crossover.py \
@@ -95,6 +95,23 @@ wait_healthy && {
     fi
 }
 
+# fp32-master ladder points for the README (small, safe compiles)
+run_bench phH_vit_small 1800 BENCH_ARCH=vit_small BENCH_BATCH=32
+run_bench phH_vit_base  1800 BENCH_ARCH=vit_base  BENCH_BATCH=16
+
+# wedge-prone giant compiles after everything cheap (the 512px flash
+# fwd+bwd compile exceeded 35 min through the tunnel helper; killing it
+# wedges the tunnel) — only the 2h trajectory runs later, and it can
+# survive on probe-waiting if a wedge clears
+run_bench phF_hr512_auto 3600 BENCH_RES=512 BENCH_BATCH=2
+run_bench phF_hr512_xla  3600 BENCH_RES=512 BENCH_BATCH=2 \
+    BENCH_OVERRIDES=kernels.flash_attention=xla
+run_bench phF_hr768_auto 3900 BENCH_RES=768 BENCH_BATCH=1
+run_bench phF_hr768_xla  3900 BENCH_RES=768 BENCH_BATCH=1 \
+    BENCH_OVERRIDES=kernels.flash_attention=xla
+
+# trajectory last: 2h of tunnel time, lowest marginal evidence (the CPU
+# trajectory + protocol eval already cover VERDICT r2 #4)
 wait_healthy && {
     note "start phE_tpu_trajectory"
     if TRAJ_STEPS=3000 TRAJ_EVAL_EVERY=500 TRAJ_ARCH=vit_small TRAJ_BATCH=64 \
@@ -105,14 +122,5 @@ wait_healthy && {
         note "FAIL  phE_tpu_trajectory rc=$?"
     fi
 }
-
-# wedge-prone giant compiles last; generous timeouts (the 512px flash
-# fwd+bwd compile exceeded 35 min through the tunnel helper)
-run_bench phF_hr512_auto 3600 BENCH_RES=512 BENCH_BATCH=2
-run_bench phF_hr512_xla  3600 BENCH_RES=512 BENCH_BATCH=2 \
-    BENCH_OVERRIDES=kernels.flash_attention=xla
-run_bench phF_hr768_auto 3900 BENCH_RES=768 BENCH_BATCH=1
-run_bench phF_hr768_xla  3900 BENCH_RES=768 BENCH_BATCH=1 \
-    BENCH_OVERRIDES=kernels.flash_attention=xla
 
 note "=== r3b queue complete; results in $RESULTS ==="
