@@ -15,8 +15,10 @@ Redesigned rather than ported:
   it per block per crop, reference:212-217);
 - optional ``nn.scan`` over the layer stack for O(1) compile time at depth
   40, and ``nn.remat`` for activation rematerialization;
-- per-sample stochastic depth (static shapes) instead of batch-subset
-  indexing.
+- stochastic depth keeps the reference's batch-subset semantics (dropped
+  samples skip branch compute) via a static keep count — see
+  ops/drop_path.py; a per-sample mask variant remains as
+  ``drop_path_mode="mask"``.
 """
 
 from __future__ import annotations
@@ -98,6 +100,7 @@ class DinoVisionTransformer(nn.Module):
     attn_impl: str = "auto"
     flash_block_q: int = 512   # kernels.flash_block_q/kv caps
     flash_block_kv: int = 512
+    flash_min_seq: int = 0     # kernels.flash_min_seq; 0 = ops default
     seq_parallel: bool = False
     scan_layers: bool = False
     pipeline_stages: int = 1       # >1: GPipe pipeline over the pipe axis
@@ -197,6 +200,7 @@ class DinoVisionTransformer(nn.Module):
             mask_k_bias=self.mask_k_bias, attn_impl=self.attn_impl,
             flash_block_q=self.flash_block_q,
             flash_block_kv=self.flash_block_kv,
+            flash_min_seq=self.flash_min_seq,
             seq_parallel=self.seq_parallel, fp8=self.fp8,
             moe_num_experts=self.moe_num_experts, moe_top_k=self.moe_top_k,
             dtype=self.dtype, param_dtype=self.param_dtype,

@@ -131,14 +131,17 @@ def dispatch_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     impl: str = "auto", reduce_dtype=jnp.float32,
     flash_block_q: int = 512, flash_block_kv: int = 512,
-    probs_dtype=None,
+    probs_dtype=None, flash_min_seq: int = 0,
 ) -> jnp.ndarray:
     if impl == "auto":
+        # 0/None = built-in default, matching kernels.flash_min_seq's
+        # documented sentinel (one convention for module and direct calls)
+        min_seq = flash_min_seq or FLASH_MIN_SEQ
         impl = (
             "pallas"
             if (
                 jax.default_backend() == "tpu"
-                and q.shape[1] >= FLASH_MIN_SEQ
+                and q.shape[1] >= min_seq
                 and _flash_available()
             )
             else "xla"
@@ -166,6 +169,7 @@ class SelfAttention(nn.Module):
     causal: bool = False  # triangular mask (dense XLA path only)
     flash_block_q: int = 512   # kernels.flash_block_q/kv caps
     flash_block_kv: int = 512
+    flash_min_seq: int = 0     # kernels.flash_min_seq; 0 = FLASH_MIN_SEQ
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -241,6 +245,7 @@ class SelfAttention(nn.Module):
                 flash_block_q=self.flash_block_q,
                 flash_block_kv=self.flash_block_kv,
                 probs_dtype=self.probs_dtype,
+                flash_min_seq=self.flash_min_seq,
             )
         out = constrain(out.reshape(B, N, self.dim), ("batch", None, "embed_act"))
 

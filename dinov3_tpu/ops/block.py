@@ -45,6 +45,7 @@ class SelfAttentionBlock(nn.Module):
     moe_top_k: int = 2
     flash_block_q: int = 512
     flash_block_kv: int = 512
+    flash_min_seq: int = 0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -71,7 +72,8 @@ class SelfAttentionBlock(nn.Module):
             attn_impl=self.attn_impl, seq_parallel=self.seq_parallel,
             fp8=self.fp8, causal=self.causal,
             flash_block_q=self.flash_block_q,
-            flash_block_kv=self.flash_block_kv, dtype=self.dtype,
+            flash_block_kv=self.flash_block_kv,
+            flash_min_seq=self.flash_min_seq, dtype=self.dtype,
             param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
             probs_dtype=self.probs_dtype,
             name="attn",
