@@ -55,8 +55,10 @@ run_bench() {
     wait_healthy || return 1
     note "start $tag (attempt timeout ${tmo}s) env: $*"
     local out rc
+    # 3*tmo: bench.py's supervisor walks up to a 3-rung fallback ladder
+    # for unpinned runs; the backstop must outlast the whole ladder
     out=$(env "$@" BENCH_ATTEMPT_TIMEOUT="$tmo" \
-          timeout $((tmo + 600)) python bench.py 2>>"$LOG")
+          timeout $((3 * tmo + 600)) python bench.py 2>>"$LOG")
     rc=$?
     if [ $rc -eq 0 ] && [ -n "$out" ]; then
         echo "{\"tag\": \"$tag\", \"rc\": 0, \"result\": $out}" >> "$RESULTS"
