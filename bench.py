@@ -13,7 +13,9 @@ on 32 A100-class GPUs = 112 img/s/GPU (vitl_im1k_lin834.yaml:3-4).
 ``vs_baseline`` is img/s/chip divided by that 112 img/s/GPU anchor.
 
 Robustness (round-2 postmortem: one transient backend outage + one remote
-compile hang cost the round its evidence):
+compile hang cost the round its evidence; round-3 postmortem: a dead
+tunnel burned the driver's whole budget on a fallback ladder that cannot
+fix infra, ending in rc=124 with no record):
 - backend init is retried with backoff (BENCH_INIT_RETRIES, default 4);
 - the persistent compilation cache is always on (/tmp/jaxcache), so a
   warm-up run earlier in the day pre-seeds the driver's bench compile;
@@ -23,11 +25,23 @@ compile hang cost the round its evidence):
 - env kill-switches bisect the step program: BENCH_PROBS=fp32|bf16
   (attention-probability storage), DINOV3_FUSED_LN=1 (Pallas layernorm),
   BENCH_OVERRIDES=comma-separated extra dot-overrides.
+- failure is ATTRIBUTABLE and BOUNDED: the measurement child exits
+  rc=3 when the backend is unreachable (probe hang / init fallback to
+  cpu — infra, not program); the supervisor then stops the fallback
+  ladder at once — varying the step program cannot fix a dead tunnel —
+  prints one JSON line ``{"skipped": "axon tunnel down...", ...}`` and
+  exits 3 within ~10 min. A total wall-time cap (BENCH_TOTAL_BUDGET,
+  default 3x attempt timeout) guarantees the supervisor always prints a
+  final attributable JSON line instead of being killed from outside.
+
+Exit codes: 0 = measured; 2 = every ladder rung failed on the program
+itself; 3 = backend unreachable (tunnel down — infra, retry later);
+5 = total budget exhausted mid-ladder. 3 and 5 still print a JSON line.
 
 Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 8 — the
 round-1 sweep's peak; those sweeps ran with bf16 masters, so the absolute
 numbers are ~20% optimistic vs today's fp32-master program — see
-MEASUREMENTS_r3.md; the B=10/B=12 re-sweep is queued in r3b_queue.sh),
+MEASUREMENTS_r3.md; the B=10/B=12 re-sweep is queued in scripts/r4_queue.sh),
 BENCH_STEPS (10), BENCH_WARMUP (3), BENCH_RES (high-res crop px).
 """
 
@@ -42,6 +56,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_IMG_S_PER_CHIP = 112.0  # Meta PyTorch ViT-L run, per A100
+
+# distinct exit codes so the round's record can never conflate "the
+# program is broken" with "the tunnel is down" (BENCH_r03 postmortem)
+RC_PROGRAM_FAILED = 2   # every ladder rung failed on the program itself
+RC_INFRA_DOWN = 3       # backend unreachable: probe hang / cpu fallback
+RC_BUDGET_EXHAUSTED = 5  # total wall-time cap hit mid-ladder
 
 _T0 = time.time()
 _PHASE = {"name": "startup", "since": _T0}
@@ -125,8 +145,8 @@ def _init_backend_with_retries(jax, retries: int, backoff: float = 20.0):
     stderr heartbeat ("in phase=init for Ns") makes that attributable to
     an external watchdog, but only the probe path is self-bounding. A
     silent fallback to cpu while the TPU was selected counts as a failed
-    attempt too — fatal (exit 2) only once retries are exhausted, so a
-    CPU number is never recorded as TPU evidence."""
+    attempt too — fatal (exit RC_INFRA_DOWN=3) only once retries are
+    exhausted, so a CPU number is never recorded as TPU evidence."""
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "420"))
     for attempt in range(retries + 1):
         err = (_probe_backend_subprocess(probe_timeout)
@@ -149,8 +169,13 @@ def _init_backend_with_retries(jax, retries: int, backoff: float = 20.0):
 
         xla_bridge._clear_backends()
         backoff *= 2
-    _log(f"FATAL: backend init failed after {retries + 1} attempts: {err}")
-    sys.exit(2)
+    # everything this helper can fail on is backend REACHABILITY (probe
+    # hang, init raise, silent cpu fallback) — infra, not the step
+    # program. The distinct rc lets the supervisor stop its fallback
+    # ladder immediately instead of walking rungs that cannot help.
+    _log(f"FATAL-INFRA: backend init failed after {retries + 1} attempts: "
+         f"{err}")
+    sys.exit(RC_INFRA_DOWN)
 
 
 def _split_overrides(s: str) -> list[str]:
@@ -223,11 +248,23 @@ def _supervise() -> int:
     (bf16-probs custom VJP, then subset drop-path) so the round still
     gets SOME TPU number.
 
+    The ladder only treats PROGRAM failures (timeout / crash) — when the
+    child reports the backend unreachable (rc=3: probe hang, init
+    fallback to cpu), no substituted program can help, so the ladder
+    stops at once and this process prints a single attributable JSON
+    line naming the tunnel, then exits 3 (round-3 postmortem: walking
+    all rungs against a dead tunnel burned ~44 min and ended in the
+    least attributable outcome, the driver's own rc=124).
+
     Attribution matters: a fallback result is labeled with the exact
     substituted env AND how every earlier rung failed (never silently
-    substituted). Worst-case wall time is len(attempts) x
-    BENCH_ATTEMPT_TIMEOUT; external backstops must be sized for the
-    full ladder (r3b_queue.sh uses 3*tmo + slack)."""
+    substituted). Worst-case wall time is capped by BENCH_TOTAL_BUDGET
+    (default 3 x BENCH_ATTEMPT_TIMEOUT): when the remaining budget
+    cannot fit another meaningful attempt, the supervisor stops and
+    still prints a final JSON line (exit 5) rather than letting an
+    external backstop kill it recordless. External backstops should be
+    sized to BENCH_TOTAL_BUDGET + slack (1 x tmo + slack for pinned
+    runs, which make exactly one attempt)."""
     import signal
 
     # the queue's backstop `timeout` SIGTERMs this supervisor: reap the
@@ -259,21 +296,67 @@ def _supervise() -> int:
         # bounded attempt, no fallback
         attempts = [{}]
     tmo = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "2700"))
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", str(3.0 * tmo)))
+    # tests drive the whole supervisor with their own victim child
+    argv = None
+    if os.environ.get("BENCH_CHILD_ARGV"):
+        argv = json.loads(os.environ["BENCH_CHILD_ARGV"])
+    t_start = time.time()
+
+    def _skip_record(reason: str, failed: list, rc: int) -> int:
+        arch = os.environ.get("BENCH_ARCH", "vit_large")
+        res = int(os.environ.get("BENCH_RES", "0"))
+        tag = f"{arch}_{res}px" if res else arch
+        print(json.dumps({
+            "metric": f"dinov3_pretrain_{tag}_imgs_per_sec_per_chip",
+            "value": None,
+            "unit": "img/s/chip",
+            "vs_baseline": None,
+            "skipped": reason,
+            "failed_rungs": failed,
+            "elapsed_s": round(time.time() - t_start, 1),
+        }))
+        return rc
+
     failed_how = []  # "<attempt-env>: <reason>" per failed rung, in order
     for i, extra in enumerate(attempts):
+        remaining = budget - (time.time() - t_start)
+        # the first rung always runs (bounded by the budget); later rungs
+        # need enough budget left for a meaningful attempt
+        if i > 0 and remaining < 300.0:
+            _log("supervisor: total budget exhausted before attempt "
+                 f"{i + 1}/{len(attempts)} (remaining {remaining:.0f}s)")
+            return _skip_record(
+                f"bench total budget ({budget:.0f}s) exhausted before "
+                f"rung {i + 1}; no attempt can complete",
+                failed_how, RC_BUDGET_EXHAUSTED)
+        eff_tmo = min(tmo, max(60.0, remaining))
         env = dict(os.environ, BENCH_SUPERVISE="0", **extra)
-        # infra failures must surface fast (rc=2) instead of eating the
-        # attempt budget and masquerading as a program timeout
+        # infra failures must surface fast (distinct rc=3) instead of
+        # eating the attempt budget and masquerading as a program
+        # timeout: the child probes with a short timeout and one retry —
+        # worst-case infra detection ~2 x 270s + backoff < 10 min
         env.setdefault("BENCH_INIT_RETRIES", "1")
+        env.setdefault("BENCH_PROBE_TIMEOUT", "270")
         _log(f"supervisor: attempt {i + 1}/{len(attempts)} "
-             f"extra={extra} timeout={tmo:.0f}s")
-        rc, out = _run_attempt(env, tmo)
+             f"extra={extra} timeout={eff_tmo:.0f}s")
+        rc, out = _run_attempt(env, eff_tmo, argv)
+        if rc == RC_INFRA_DOWN:
+            # a dead tunnel is not fixable by substituting the step
+            # program: stop the ladder, leave a fast attributable record
+            _log("supervisor: child reported backend unreachable "
+                 "(rc=3); stopping the ladder — infra, not program")
+            return _skip_record(
+                "axon tunnel down: backend init probe failed in the "
+                "measurement child (infra failure, not a program "
+                "failure; retry when the tunnel is healthy)",
+                failed_how, RC_INFRA_DOWN)
         if rc == 124:
-            _log(f"supervisor: attempt {i + 1} timed out after {tmo:.0f}s "
-                 "(stuck phase named in the heartbeat above); "
-                 "process group killed")
+            _log(f"supervisor: attempt {i + 1} timed out after "
+                 f"{eff_tmo:.0f}s (stuck phase named in the heartbeat "
+                 "above); process group killed")
             failed_how.append(f"{extra or 'default'}: timed out "
-                              f"after {tmo:.0f}s")
+                              f"after {eff_tmo:.0f}s")
             continue
         if rc == 0 and out.strip():
             line = out.strip().splitlines()[-1]
@@ -292,7 +375,10 @@ def _supervise() -> int:
         _log(f"supervisor: attempt {i + 1} failed rc={rc}")
         failed_how.append(f"{extra or 'default'}: failed rc={rc}")
     _log("supervisor: all attempts failed")
-    return 2
+    return _skip_record(
+        "every fallback rung failed on the program itself (see "
+        "failed_rungs); not an infra failure",
+        failed_how, RC_PROGRAM_FAILED)
 
 
 def main():

@@ -23,6 +23,26 @@ from dinov3_tpu.ops.ffn import make_ffn_layer
 from dinov3_tpu.ops.layer_scale import LayerScale
 from dinov3_tpu.ops.norms import make_norm_layer
 
+_SUBSET_FALLBACK_WARNED: set[str] = set()
+
+
+def _warn_subset_fallback(reason: str) -> None:
+    """One-time (per reason) trace-time warning when a configured
+    ``drop_path_mode=subset`` degrades to mask semantics — silent
+    degradation would let bench records and docs label a mask program
+    as the subset one (ADVICE r3)."""
+    if reason in _SUBSET_FALLBACK_WARNED:
+        return
+    _SUBSET_FALLBACK_WARNED.add(reason)
+    import warnings
+
+    warnings.warn(
+        "drop_path_mode=subset degraded to mask semantics for this "
+        f"program: {reason}. Throughput/FLOP numbers for this run are "
+        "mask-program numbers.",
+        stacklevel=3,
+    )
+
 
 class SelfAttentionBlock(nn.Module):
     dim: int
@@ -109,11 +129,23 @@ class SelfAttentionBlock(nn.Module):
             mesh = get_current_mesh()
             B = x.shape[0]
             G = data_parallel_size(mesh) if mesh is not None else 1
-            groups = G if (G > 1 and B % G == 0) else 1
-            if subset_keep_count(B // groups, self.drop_path_rate) >= B // groups:
+            groups = G
+            if G > 1 and B % G != 0:
+                # an ungrouped (groups=1) subset gather under a >1-shard
+                # data axis crosses shard spans: GSPMD either fails to
+                # partition the gathered activation or inserts heavy
+                # resharding, with no clear error (ADVICE r3). Mask mode
+                # is per-sample and shards cleanly — use it.
+                _warn_subset_fallback(
+                    f"batch {B} not divisible by data-shard count {G}")
+                use_subset = False
+            elif subset_keep_count(B // groups, self.drop_path_rate) >= B // groups:
                 # batch too small for the rate (e.g. single-row pipeline
                 # microbatches): subsetting would silently disable drop
                 # path — fall back to the per-sample mask for this call
+                _warn_subset_fallback(
+                    f"per-group batch {B // groups} too small for "
+                    f"rate {self.drop_path_rate}")
                 use_subset = False
         if use_subset:
             # reference semantics (block.py:94-117): the branch runs on a

@@ -58,6 +58,70 @@ def _proc_state(pid: int) -> str | None:
         return None
 
 
+def _supervise_with_victim(monkeypatch, capsys, victim_prog: str,
+                           env: dict[str, str]):
+    """Drive the REAL supervisor end-to-end with a victim child program
+    (BENCH_CHILD_ARGV) standing in for the measurement child."""
+    import json
+
+    monkeypatch.setenv(
+        "BENCH_CHILD_ARGV",
+        json.dumps([sys.executable, "-c", victim_prog]),
+    )
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    rc = bench._supervise()
+    out = capsys.readouterr().out.strip()
+    assert out, "supervisor must always print a final JSON line"
+    return rc, json.loads(out.splitlines()[-1])
+
+
+def test_supervise_infra_fast_fail(monkeypatch, capsys):
+    """A child reporting rc=3 (backend unreachable) must stop the ladder
+    at the FIRST rung and leave an attributable 'tunnel down' record —
+    the BENCH_r03 dead-tunnel scenario, which previously walked all
+    rungs into the driver's rc=124."""
+    import time
+
+    t0 = time.time()
+    rc, rec = _supervise_with_victim(
+        monkeypatch, capsys, "import sys; sys.exit(3)",
+        {"BENCH_ATTEMPT_TIMEOUT": "600"},
+    )
+    assert rc == bench.RC_INFRA_DOWN
+    assert "axon tunnel down" in rec["skipped"]
+    assert rec["value"] is None
+    assert rec["failed_rungs"] == []  # stopped before burning any rung
+    # one victim spawn (~5-10s sitecustomize preimport), not 3 x timeout
+    assert time.time() - t0 < 60
+
+
+def test_supervise_budget_cap_always_prints(monkeypatch, capsys):
+    """When the total budget cannot fit another rung, the supervisor
+    stops and still prints a final JSON line (rc=5) instead of letting
+    an external backstop kill it recordless."""
+    rc, rec = _supervise_with_victim(
+        monkeypatch, capsys, "import time; time.sleep(600)",
+        {"BENCH_ATTEMPT_TIMEOUT": "20", "BENCH_TOTAL_BUDGET": "25"},
+    )
+    assert rc == bench.RC_BUDGET_EXHAUSTED
+    assert "budget" in rec["skipped"]
+    assert len(rec["failed_rungs"]) == 1  # rung 1 timed out, rung 2 never ran
+    assert "timed out" in rec["failed_rungs"][0]
+
+
+def test_supervise_program_failure_walks_ladder(monkeypatch, capsys):
+    """A program crash (rc=1) is NOT infra: the ladder walks every rung
+    and the final record names each rung's failure."""
+    rc, rec = _supervise_with_victim(
+        monkeypatch, capsys, "import sys; sys.exit(1)",
+        {"BENCH_ATTEMPT_TIMEOUT": "600"},
+    )
+    assert rc == bench.RC_PROGRAM_FAILED
+    assert len(rec["failed_rungs"]) == 3
+    assert "not an infra failure" in rec["skipped"]
+
+
 def test_run_attempt_kills_process_group(tmp_path):
     """_run_attempt (the real supervisor mechanism) must reap a hung
     grandchild on timeout — the orphaned-probe scenario."""
