@@ -40,11 +40,14 @@ def _softmax_lowp(logits, out_dtype):
     """Softmax with fp32 statistics but low-precision output AND residual.
 
     Autodiff of a plain ``softmax(logits).astype(bf16)`` saves the fp32
-    probabilities for the backward — at ViT-L's 224px global crops that is
-    a [16, 16, 201, 201] fp32 array per layer whose save/transpose copies
-    showed up as ~12 ms/step of pure `copy-done` traffic in the round-2
-    profile. Storing the residual in ``out_dtype`` (bf16) halves that
-    traffic; the backward (dL = p * (g - sum(g*p))) accumulates in fp32.
+    probabilities for the backward — at ViT-L's 224px global crops that
+    is a [16, 16, 201, 201] fp32 array per layer whose save/transpose
+    copies are pure HBM traffic. Storing the residual in ``out_dtype``
+    (bf16) halves that traffic; the backward (dL = p * (g - sum(g*p)))
+    accumulates in fp32. Committed A/B on the fp32-master program:
+    47.58 -> 48.07 img/s/chip (BENCH_r03_phases.jsonl, bf16 vs fp32
+    probs storage); the per-layer breakdown awaits the committed phD
+    profile artifact (scripts/r4_queue.sh).
     """
     return jax.nn.softmax(logits, axis=-1).astype(out_dtype)
 
