@@ -1,13 +1,21 @@
-"""Recipe ablation: full DINOv3 losses vs DINO-only, same data/arch/steps.
+"""Recipe ablation: the DINOv3 loss set deleted one piece at a time.
 
 VERDICT r3 #7: the digits trajectory proves the recipe *trains*, but
 nothing showed the iBOT/KoLeo parts of the recipe *mattering*. This
-harness trains two arms on the procedural texture dataset
+harness trains loss-ablation arms on the procedural texture dataset
 (dinov3_tpu/data/textures.py — class = spatial structure, color
 decorrelated from label):
 
   full:       DINO + iBOT + KoLeo (the pretrain recipe defaults)
   dino_only:  ibot.loss_weight=0, dino.koleo_loss_weight=0
+  no_koleo:   DINO + iBOT        (dino.koleo_loss_weight=0)
+  no_ibot:    DINO + KoLeo       (ibot.loss_weight=0)
+
+The default ABL_ARMS runs the headline pair (full vs dino_only); the
+committed ABLATION_r04.json is the full 2x2 factorial, i.e. two
+invocations more with ABL_ARMS=no_koleo and ABL_ARMS=no_ibot (results
+from repeat invocations into the same out_dir are merged by the caller;
+each run rewrites out_dir/ABLATION.json with its own arms only).
 
 and records the held-out k-NN / linear-probe trajectory of each arm via
 the in-training eval harness (reference's do_test slot —
@@ -33,8 +41,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 ARMS = {
     "full": [],
     "dino_only": ["ibot.loss_weight=0.0", "dino.koleo_loss_weight=0.0"],
-    # optional third arm: KoLeo alone off, isolates iBOT's contribution
+    # single-loss deletions complete the factorial with dino_only/full:
     "no_koleo": ["dino.koleo_loss_weight=0.0"],
+    "no_ibot": ["ibot.loss_weight=0.0"],
 }
 
 
