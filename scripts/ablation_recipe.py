@@ -12,10 +12,10 @@ decorrelated from label):
   no_ibot:    DINO + KoLeo       (ibot.loss_weight=0)
 
 The default ABL_ARMS runs the headline pair (full vs dino_only); the
-committed ABLATION_r04.json is the full 2x2 factorial, i.e. two
-invocations more with ABL_ARMS=no_koleo and ABL_ARMS=no_ibot (results
-from repeat invocations into the same out_dir are merged by the caller;
-each run rewrites out_dir/ABLATION.json with its own arms only).
+committed ABLATION_r04.json is the full 2x2 factorial, i.e. two more
+invocations with ABL_ARMS=no_koleo and ABL_ARMS=no_ibot into the same
+out_dir — out_dir/ABLATION.json merges arms by name across invocations
+(a re-run arm replaces its previous record).
 
 and records the held-out k-NN / linear-probe trajectory of each arm via
 the in-training eval harness (reference's do_test slot —
@@ -113,13 +113,22 @@ def main():
         n_train_per_class=n_train, n_val_per_class=n_val,
     )
 
+    art_path = os.path.join(out, "ABLATION.json")
     results = []
+    if os.path.isfile(art_path):
+        # merge across invocations by arm name (a re-run arm replaces
+        # its old record), so the documented multi-invocation factorial
+        # accumulates into ONE artifact instead of each run clobbering
+        # the previous arms
+        with open(art_path) as f:
+            results = [a for a in json.load(f).get("arms", [])
+                       if a["arm"] not in arms]
     for arm in arms:
         print(f"[ablation] arm={arm} steps={steps}", flush=True)
         results.append(run_arm(arm, out, train_dir, val_dir, steps,
                                eval_every, arch, batch))
         # incremental write: a killed second arm still leaves the first
-        with open(os.path.join(out, "ABLATION.json"), "w") as f:
+        with open(art_path, "w") as f:
             json.dump({
                 "dataset": "procedural textures, 12 classes = motif x "
                            "frequency-band, per-image palette "

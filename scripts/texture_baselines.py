@@ -1,0 +1,100 @@
+"""Floor baselines for the texture ablation (context for ABLATION_r04).
+
+Two floors show where the trained numbers stand:
+  pixel k-NN        — k-NN on raw normalized 32px pixels: measures how
+                      much of the class is readable without any
+                      learning (the dataset was built so palette is
+                      uninformative; this should sit near chance).
+  random-init       — the in-training eval harness run on an UNTRAINED
+                      vit_test4 backbone: the iteration-0 point of every
+                      trajectory/ablation curve.
+
+Usage: JAX_PLATFORMS=cpu python scripts/texture_baselines.py [out_dir]
+(out_dir should be the ablation out_dir so the same texture tree is
+reused; defaults to /tmp/abl_full.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from dinov3_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
+
+    import numpy as np
+    from PIL import Image
+
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.data.textures import materialize_textures
+    from dinov3_tpu.evals.knn import knn_eval
+
+    out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/abl_full"
+    tex_root = os.path.join(out, "textures")
+    manifest_path = os.path.join(tex_root, "manifest.json")
+    if os.path.isfile(manifest_path):
+        # NEVER regenerate here: the baselines must be computed on the
+        # exact tree the ablation curves used, whatever its counts —
+        # calling with default counts would rmtree a smoke-sized tree
+        with open(manifest_path) as f:
+            m = json.load(f)
+        train_dir, val_dir = materialize_textures(
+            tex_root, n_train_per_class=m["n_train_per_class"],
+            n_val_per_class=m["n_val_per_class"], px=m["px"],
+            seed=m["seed"])
+    else:
+        train_dir, val_dir = materialize_textures(tex_root)
+
+    def load_split(root, px=32):
+        xs, ys = [], []
+        classes = sorted(os.listdir(root))
+        for ci, c in enumerate(classes):
+            cdir = os.path.join(root, c)
+            for f in sorted(os.listdir(cdir)):
+                im = Image.open(os.path.join(cdir, f)).resize(
+                    (px, px), Image.BICUBIC)
+                xs.append(np.asarray(im, np.float32).reshape(-1) / 255.0)
+                ys.append(ci)
+        return np.stack(xs), np.asarray(ys)
+
+    xtr, ytr = load_split(train_dir)
+    xva, yva = load_split(val_dir)
+    pixel_knn = knn_eval(xtr, ytr, xva, yva, n_classes=12, k=10)
+
+    # untrained backbone through the SAME eval harness the trajectories
+    # use — the iteration-0 point of every committed curve. The shared
+    # builder (random init when ckpt_dir is None) keeps the init path —
+    # jit + unbox — identical to the certification CLI's.
+    from dinov3_tpu.evals import do_eval
+    from dinov3_tpu.models import build_model_for_eval
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        "student.arch=vit_test4", "student.patch_size=4",
+        "crops.global_crops_size=32", "crops.local_crops_size=16",
+        f"data.root={train_dir}", "data.backend=folder",
+        f"evaluation.train_dataset_path=Folder:root={train_dir}",
+        f"evaluation.val_dataset_path=Folder:root={val_dir}",
+    ])
+    model, params = build_model_for_eval(cfg, ckpt_dir=None)
+    rand = do_eval(cfg, model, params, n_classes=12)
+
+    rec = {
+        "pixel_knn_top1": round(pixel_knn, 4),
+        "random_init_knn_top1": round(rand["knn_top1"], 4),
+        "random_init_linear_top1": round(rand["linear_top1"], 4),
+        "chance": round(1 / 12, 4),
+    }
+    print(json.dumps(rec))
+    with open(os.path.join(out, "BASELINES.json"), "w") as f:
+        json.dump(rec, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
