@@ -451,7 +451,17 @@ def main():
     scalars = setup.scalars(0)
 
     _phase("compile")
-    compiled = setup.step_fn.lower(state, dbatch, scalars, rng).compile()
+    import warnings as _warnings
+
+    # the block emits a one-time warning at trace time when a configured
+    # drop_path_mode=subset degrades to mask semantics (tiny or
+    # indivisible per-shard batch) — surface that in the record so an
+    # A/B labeled "subset" can never silently be the mask program
+    with _warnings.catch_warnings(record=True) as _caught:
+        _warnings.simplefilter("always")
+        compiled = setup.step_fn.lower(state, dbatch, scalars, rng).compile()
+    degraded = [str(w.message) for w in _caught
+                if "degraded to mask semantics" in str(w.message)]
     _log("compile done")
 
     steps = max(1, steps)
@@ -473,12 +483,17 @@ def main():
 
     img_s_chip = B / dt / n
     tag = f"{arch}_{res}px" if res else arch
-    print(json.dumps({
+    rec = {
         "metric": f"dinov3_pretrain_{tag}_imgs_per_sec_per_chip",
         "value": round(img_s_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),
-    }))
+    }
+    if degraded:
+        # distinct reasons can fire for the global- and local-crop
+        # batches of the same program — keep them all
+        rec["drop_path_degraded"] = "; ".join(degraded)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

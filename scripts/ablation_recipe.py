@@ -81,7 +81,10 @@ def run_arm(name: str, out: str, train_dir: str, val_dir: str,
         for line in f:
             traj.append(json.loads(line))
     return {"arm": name, "overrides": ARMS[name], "trajectory": traj,
-            "final_loss": result.get("final_loss")}
+            "final_loss": result.get("final_loss"),
+            # per-arm metadata: merged artifacts can span invocations
+            # with different settings, so each arm records its own
+            "steps": steps, "arch": arch, "batch": batch}
 
 
 def main():
@@ -119,24 +122,34 @@ def main():
         # merge across invocations by arm name (a re-run arm replaces
         # its old record), so the documented multi-invocation factorial
         # accumulates into ONE artifact instead of each run clobbering
-        # the previous arms
-        with open(art_path) as f:
-            results = [a for a in json.load(f).get("arms", [])
-                       if a["arm"] not in arms]
+        # the previous arms. A truncated artifact (killed mid-write of
+        # a non-atomic writer from an older revision) must not brick
+        # every later invocation — start fresh instead.
+        try:
+            with open(art_path) as f:
+                results = [a for a in json.load(f).get("arms", [])
+                           if a["arm"] not in arms]
+        except ValueError:
+            print(f"[ablation] {art_path} unreadable; starting fresh",
+                  flush=True)
     for arm in arms:
         print(f"[ablation] arm={arm} steps={steps}", flush=True)
         results.append(run_arm(arm, out, train_dir, val_dir, steps,
                                eval_every, arch, batch))
-        # incremental write: a killed second arm still leaves the first
-        with open(art_path, "w") as f:
+        # incremental + atomic: a killed later arm still leaves a
+        # parseable artifact with every completed arm
+        tmp_path = art_path + ".tmp"
+        with open(tmp_path, "w") as f:
             json.dump({
                 "dataset": "procedural textures, 12 classes = motif x "
                            "frequency-band, per-image palette "
                            f"({12 * n_train} train / {12 * n_val} val "
-                           "PNGs, folder backend)",
+                           "PNGs, folder backend; per-arm metadata in "
+                           "each arm record)",
                 "arch": arch, "steps": steps, "batch": batch,
                 "arms": results,
             }, f, indent=2)
+        os.replace(tmp_path, art_path)
     print(json.dumps(results[-1]["trajectory"][-1:], indent=2))
 
 

@@ -38,18 +38,19 @@ def main():
     out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/abl_full"
     tex_root = os.path.join(out, "textures")
     manifest_path = os.path.join(tex_root, "manifest.json")
-    if os.path.isfile(manifest_path):
-        # NEVER regenerate here: the baselines must be computed on the
-        # exact tree the ablation curves used, whatever its counts —
-        # calling with default counts would rmtree a smoke-sized tree
-        with open(manifest_path) as f:
-            m = json.load(f)
-        train_dir, val_dir = materialize_textures(
-            tex_root, n_train_per_class=m["n_train_per_class"],
-            n_val_per_class=m["n_val_per_class"], px=m["px"],
-            seed=m["seed"])
-    else:
-        train_dir, val_dir = materialize_textures(tex_root)
+    if not os.path.isfile(manifest_path):
+        # NEVER generate here: the whole point of these floors is that
+        # they are computed on the exact tree the ablation curves used —
+        # fabricating a fresh default tree would silently decouple them
+        raise SystemExit(
+            f"no texture manifest under {tex_root}; run "
+            "scripts/ablation_recipe.py into this out_dir first")
+    with open(manifest_path) as f:
+        m = json.load(f)
+    train_dir, val_dir = materialize_textures(
+        tex_root, n_train_per_class=m["n_train_per_class"],
+        n_val_per_class=m["n_val_per_class"], px=m["px"],
+        seed=m["seed"])
 
     def load_split(root, px=32):
         xs, ys = [], []
@@ -65,7 +66,13 @@ def main():
 
     xtr, ytr = load_split(train_dir)
     xva, yva = load_split(val_dir)
-    pixel_knn = knn_eval(xtr, ytr, xva, yva, n_classes=12, k=10)
+    # the eval harness's loaders use drop_last=True at batch 64, so the
+    # trajectory numbers see only the first floor(N/64)*64 samples in
+    # dataset order — evaluate the pixel floor on the SAME population
+    n_tr = (len(xtr) // 64) * 64 or len(xtr)
+    n_va = (len(xva) // 64) * 64 or len(xva)
+    pixel_knn = knn_eval(xtr[:n_tr], ytr[:n_tr], xva[:n_va], yva[:n_va],
+                         n_classes=12, k=10)
 
     # untrained backbone through the SAME eval harness the trajectories
     # use — the iteration-0 point of every committed curve. The shared
@@ -83,7 +90,9 @@ def main():
         f"evaluation.val_dataset_path=Folder:root={val_dir}",
     ])
     model, params = build_model_for_eval(cfg, ckpt_dir=None)
-    rand = do_eval(cfg, model, params, n_classes=12)
+    # default n_classes (1000-way probe) to match the in-training
+    # do_eval call every committed trajectory point used
+    rand = do_eval(cfg, model, params)
 
     rec = {
         "pixel_knn_top1": round(pixel_knn, 4),
