@@ -77,7 +77,60 @@ def _phase(name: str) -> None:
     _log(f"phase={name}")
 
 
+def _maybe_stall_probe(state: dict, stall_after: float,
+                       probe_tmo: float) -> None:
+    """Mid-run tunnel-death detection (VERDICT r4 weak #5). The init
+    phase is self-bounding (killable probe subprocess), but a tunnel
+    that dies BETWEEN the probe's success and the device work leaves
+    compile/warmup/measure hung on an unkillable C++ call — previously
+    only an external watchdog (rc=124, unattributable) ended it. When a
+    device phase has been stuck past ``stall_after``, re-probe the
+    backend in a killable subprocess; two consecutive failed probes
+    convert the stall into the same attributable rc=3 the init path
+    uses. One healthy probe resets the count, so a legitimately slow
+    compile on a live tunnel is never killed (the probe spawns a fresh
+    backend connection, which the axon pool accepts independently of
+    the in-flight compile)."""
+    if _PHASE["name"] not in ("compile", "warmup", "measure"):
+        state["fails"] = 0
+        return
+    if _PHASE["name"] != state.get("phase"):
+        # advancing to the NEXT device phase is itself proof of a live
+        # tunnel — strikes must not accumulate across phase boundaries
+        # (two non-consecutive flakes in different phases are not the
+        # "two consecutive failures" this detector promises)
+        state["phase"] = _PHASE["name"]
+        state["fails"] = 0
+    if time.time() - _PHASE["since"] < stall_after or not _tpu_required():
+        return
+    # healthy probes re-arm only once per stall_after window; FAILED
+    # probes retry at the next heartbeat so the 2-strike confirmation
+    # lands within ~stall_after + 2*probe_tmo + heartbeat (~9 min at
+    # defaults), not another full window later
+    if state["fails"] == 0 and time.time() - state["last_probe"] < stall_after:
+        return
+    state["last_probe"] = time.time()
+    err = _probe_backend_subprocess(probe_tmo)
+    if err is None:
+        state["fails"] = 0
+        _log(f"stall probe: phase={_PHASE['name']} slow but tunnel "
+             "healthy; waiting")
+        return
+    state["fails"] += 1
+    _log(f"stall probe failed ({state['fails']}/2): {err}")
+    if state["fails"] >= 2:
+        _log(f"FATAL-INFRA: phase={_PHASE['name']} stalled "
+             f"{time.time() - _PHASE['since']:.0f}s and the tunnel "
+             "re-probe failed twice; exiting rc=3 (infra, not program)")
+        sys.stderr.flush()
+        os._exit(RC_INFRA_DOWN)
+
+
 def _watchdog(period: float = 60.0) -> None:
+    stall_after = float(os.environ.get("BENCH_STALL_PROBE_AFTER", "240"))
+    probe_tmo = float(os.environ.get("BENCH_STALL_PROBE_TIMEOUT", "120"))
+    state = {"last_probe": 0.0, "fails": 0}
+
     def run():
         while True:
             time.sleep(period)
@@ -85,6 +138,7 @@ def _watchdog(period: float = 60.0) -> None:
                 f"heartbeat: in phase={_PHASE['name']} "
                 f"for {time.time() - _PHASE['since']:.0f}s"
             )
+            _maybe_stall_probe(state, stall_after, probe_tmo)
 
     threading.Thread(target=run, daemon=True).start()
 
@@ -330,7 +384,6 @@ def _supervise() -> int:
                 f"bench total budget ({budget:.0f}s) exhausted before "
                 f"rung {i + 1}; no attempt can complete",
                 failed_how, RC_BUDGET_EXHAUSTED)
-        eff_tmo = min(tmo, max(60.0, remaining))
         env = dict(os.environ, BENCH_SUPERVISE="0", **extra)
         # infra failures must surface fast (distinct rc=3) instead of
         # eating the attempt budget and masquerading as a program
@@ -338,6 +391,28 @@ def _supervise() -> int:
         # worst-case infra detection ~2 x 270s + backoff < 10 min
         env.setdefault("BENCH_INIT_RETRIES", "1")
         env.setdefault("BENCH_PROBE_TIMEOUT", "270")
+        eff_tmo = min(tmo, max(60.0, remaining))
+        # ADVICE r4: a rung whose timeout was SHRUNK (by a small
+        # remaining budget) below the child's worst-case infra-detection
+        # time would kill a dead-tunnel child at the attempt timeout and
+        # record it as a program rc=124 — misclassification. Skip to the
+        # attributable budget-exhausted record instead. The floor only
+        # applies to budget shrinkage: a caller-chosen BENCH_ATTEMPT_
+        # TIMEOUT below the floor is a conscious trade (smoke/test runs).
+        init_r = int(env["BENCH_INIT_RETRIES"])
+        infra_floor = ((init_r + 1) * float(env["BENCH_PROBE_TIMEOUT"])
+                       + 20.0 * init_r + 90.0)
+        if eff_tmo < min(tmo, infra_floor):
+            _log(f"supervisor: remaining budget ({remaining:.0f}s) is "
+                 f"below the child's infra-detection floor "
+                 f"({infra_floor:.0f}s); stopping with a budget record "
+                 "rather than risking an unattributable rc=124")
+            return _skip_record(
+                f"bench total budget ({budget:.0f}s) cannot fit the "
+                f"child's infra-detection floor ({infra_floor:.0f}s) at "
+                f"rung {i + 1}; stopping so an infra outage is never "
+                "recorded as a program timeout",
+                failed_how, RC_BUDGET_EXHAUSTED)
         _log(f"supervisor: attempt {i + 1}/{len(attempts)} "
              f"extra={extra} timeout={eff_tmo:.0f}s")
         rc, out = _run_attempt(env, eff_tmo, argv)
@@ -347,9 +422,11 @@ def _supervise() -> int:
             _log("supervisor: child reported backend unreachable "
                  "(rc=3); stopping the ladder — infra, not program")
             return _skip_record(
-                "axon tunnel down: backend init probe failed in the "
-                "measurement child (infra failure, not a program "
-                "failure; retry when the tunnel is healthy)",
+                "axon tunnel down: backend unreachable in the "
+                "measurement child (init probe failed, or a mid-run "
+                "stall re-probe failed twice — the child's stderr names "
+                "the phase; infra failure, not a program failure; retry "
+                "when the tunnel is healthy)",
                 failed_how, RC_INFRA_DOWN)
         if rc == 124:
             _log(f"supervisor: attempt {i + 1} timed out after "

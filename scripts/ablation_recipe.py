@@ -46,13 +46,30 @@ ARMS = {
     "no_ibot": ["ibot.loss_weight=0.0"],
 }
 
+DEFAULT_ARCH = "vit_test4"
+
+
+def record_name(name: str, arch: str) -> str:
+    """Merge key for ABLATION.json: arm name, arch-suffixed when the
+    arch is non-default so invocations at different widths never
+    silently replace each other's records (ADVICE r4)."""
+    return name if arch == DEFAULT_ARCH else f"{name}_{arch}"
+
 
 def run_arm(name: str, out: str, train_dir: str, val_dir: str,
             steps: int, eval_every: int, arch: str, batch: int) -> dict:
     from dinov3_tpu.train.train import main as train_main
 
     epoch_len = eval_every
-    run_dir = os.path.join(out, f"run_{name}")
+    run_dir = os.path.join(out, f"run_{record_name(name, arch)}")
+    # train.py appends to <run_dir>/evals.json and --no-resume does not
+    # clear the output dir, so a re-run arm would otherwise read the
+    # stale previous invocation's eval lines concatenated with its own
+    # (ADVICE r4): truncate before training.
+    try:
+        os.remove(os.path.join(run_dir, "evals.json"))
+    except OSError:
+        pass
     result = train_main([
         "--output-dir", run_dir, "--no-resume",
         f"student.arch={arch}", "student.patch_size=4",
@@ -80,7 +97,8 @@ def run_arm(name: str, out: str, train_dir: str, val_dir: str,
     with open(os.path.join(run_dir, "evals.json")) as f:
         for line in f:
             traj.append(json.loads(line))
-    return {"arm": name, "overrides": ARMS[name], "trajectory": traj,
+    return {"arm": record_name(name, arch), "overrides": ARMS[name],
+            "trajectory": traj,
             "final_loss": result.get("final_loss"),
             # per-arm metadata: merged artifacts can span invocations
             # with different settings, so each arm records its own
@@ -125,10 +143,21 @@ def main():
         # the previous arms. A truncated artifact (killed mid-write of
         # a non-atomic writer from an older revision) must not brick
         # every later invocation — start fresh instead.
+        replaced = {record_name(a, arch) for a in arms}
+
+        def _stale(rec: dict) -> bool:
+            # also drop OLD-format records written by the pre-suffix
+            # revision: bare arm name at a non-default arch whose
+            # recorded arch metadata matches this invocation — they are
+            # the same (arm, arch) cell and must be replaced, not kept
+            # as a second ambiguous entry
+            return (rec["arm"] in replaced
+                    or (rec["arm"] in arms and rec.get("arch") == arch))
+
         try:
             with open(art_path) as f:
                 results = [a for a in json.load(f).get("arms", [])
-                           if a["arm"] not in arms]
+                           if not _stale(a)]
         except ValueError:
             print(f"[ablation] {art_path} unreadable; starting fresh",
                   flush=True)

@@ -1,0 +1,170 @@
+#!/bin/bash
+# Round-5 TPU measurement queue — successor of r4_queue.sh. The phase
+# list is VERDICT r4's "next round" ladder, cheap/high-evidence first,
+# wedge-prone giant compiles last (killing a hung 35-min remote compile
+# wedges the tunnel for hours — see r3):
+#   phA  default program (subset drop-path): the headline number
+#        (VERDICT r4 missing #1/#2 — two rounds queued, zero measured)
+#   phB  drop_path_mode=mask A/B — isolates the subset win
+#   phC  batch sweep at B=10/B=12 (the FLOP cut may shift the peak)
+#   phG  op-level flash-vs-dense attention crossover -> flash_min_seq
+#   phD  profile of the default step program (committed artifact)
+#   phH  fp32-master ViT-S/B ladder points (small, safe compiles)
+#   phF  full-step high-res crossover (512/768px, scanned blocks)
+#   phE  ViT-S accuracy rung on the texture dataset, full vs no_ibot
+#        (does iBOT turn positive at real width? VERDICT r4 weak #3)
+#
+# Usage: bash scripts/r5_queue.sh   (env: RESULTS, QUEUE_LOG, DEADLINE_HOURS)
+
+set -u
+cd "$(dirname "$0")/.."
+RESULTS="${RESULTS:-/tmp/r5_results.jsonl}"
+LOG="${QUEUE_LOG:-/tmp/r5_queue.log}"
+DEADLINE=$(( $(date +%s) + ${DEADLINE_HOURS:-10} * 3600 ))
+
+note() { echo "[r5 $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+remaining() { echo $(( DEADLINE - $(date +%s) )); }
+
+probe() {
+    timeout 300 python - <<'EOF' >>"$LOG" 2>&1
+import sys
+sys.path.insert(0, ".")
+from dinov3_tpu.utils import respect_jax_platforms_env
+respect_jax_platforms_env()
+import jax
+assert jax.default_backend() != "cpu", "fell back to cpu"
+print("PROBE-OK", jax.device_count())
+EOF
+}
+
+wait_healthy() {
+    while [ "$(remaining)" -gt 0 ]; do
+        if probe; then note "probe healthy"; return 0; fi
+        note "probe unhealthy; sleeping 240s ($(( $(remaining) / 60 )) min to deadline)"
+        sleep 240
+    done
+    note "deadline reached while waiting for a healthy tunnel"
+    return 1
+}
+
+# gate_phase <backstop_s> <tag>: true iff the deadline leaves room for
+# the phase's worst case AND the tunnel is healthy
+gate_phase() {
+    local backstop="$1" tag="$2"
+    if [ "$(remaining)" -le "$backstop" ]; then
+        note "SKIP $tag: ${backstop}s backstop does not fit in $(remaining)s to deadline"
+        return 1
+    fi
+    wait_healthy || return 1
+    # wait_healthy may have slept for hours: re-check the fit so a
+    # late-healthy tunnel cannot launch a phase past the deadline
+    if [ "$(remaining)" -le "$backstop" ]; then
+        note "SKIP $tag: deadline closed in while waiting for a healthy probe"
+        return 1
+    fi
+    return 0
+}
+
+# run_bench <tag> <tmo> <pinned|ladder> [ENV=...]...
+run_bench() {
+    local tag="$1" tmo="$2" kind="$3"; shift 3
+    local backstop budget
+    if [ "$kind" = pinned ]; then
+        budget=$tmo; backstop=$((tmo + 600))
+    else
+        budget=$((3 * tmo)); backstop=$((3 * tmo + 600))
+    fi
+    local try rc out
+    for try in 1 2; do
+        gate_phase "$backstop" "$tag" || return 1
+        note "start $tag try=$try (tmo=${tmo}s budget=${budget}s) env: $*"
+        out=$(env "$@" BENCH_ATTEMPT_TIMEOUT="$tmo" BENCH_TOTAL_BUDGET="$budget" \
+              timeout "$backstop" python bench.py 2>>"$LOG")
+        rc=$?
+        if [ $rc -eq 0 ] && [ -n "$out" ]; then
+            echo "{\"tag\": \"$tag\", \"rc\": 0, \"result\": $out}" >> "$RESULTS"
+            note "done  $tag -> $out"
+            return 0
+        fi
+        # keep the attributable skip record even on failure
+        if [ -n "$out" ]; then
+            echo "{\"tag\": \"$tag\", \"rc\": $rc, \"result\": $out}" >> "$RESULTS"
+        else
+            echo "{\"tag\": \"$tag\", \"rc\": $rc, \"result\": null}" >> "$RESULTS"
+        fi
+        if [ $rc -eq 3 ] && [ $try -eq 1 ]; then
+            note "INFRA $tag rc=3 (tunnel died mid-run); re-gating on probe for one retry"
+            continue
+        fi
+        note "FAIL  $tag rc=$rc"
+        return $rc
+    done
+}
+
+note "=== r5 queue starting; deadline $(date -d @$DEADLINE +%H:%M:%S) ==="
+
+# phA: the headline — default program (subset drop-path, bf16 probs),
+# unpinned so the driver-identical ladder defends it. A success also
+# pre-seeds /tmp/jaxcache for the driver's end-of-round bench.
+run_bench phA_subset_default 2100 ladder
+# phB: mask A/B — pinned (a substituted program would break the A/B)
+run_bench phB_mask_ab        2100 pinned BENCH_OVERRIDES=student.drop_path_mode=mask
+# phC: batch sweep — pinned via a no-op BENCH_PROBS=bf16 (the default)
+# so a ladder substitution can never mislabel a sweep point
+run_bench phC_b10            2100 pinned BENCH_BATCH=10 BENCH_PROBS=bf16
+run_bench phC_b12            2100 pinned BENCH_BATCH=12 BENCH_PROBS=bf16
+
+gate_phase 2400 phG_attn_crossover && {
+    note "start phG_attn_crossover"
+    if timeout 2400 python scripts/bench_attention_crossover.py \
+            /tmp/attn_crossover.jsonl >> "$LOG" 2>&1; then
+        note "done  phG_attn_crossover -> /tmp/attn_crossover.jsonl"
+    else
+        note "FAIL  phG_attn_crossover rc=$?"
+    fi
+}
+
+gate_phase 2400 phD_profile && {
+    note "start phD_profile"
+    if timeout 2400 python scripts/profile_step.py /tmp/prof_r5 \
+            >> "$LOG" 2>&1; then
+        note "done  phD_profile -> /tmp/prof_r5"
+    else
+        note "FAIL  phD_profile rc=$?"
+    fi
+}
+
+# fp32-master ladder points for the README (small, safe compiles;
+# BENCH_ARCH pins them to a single attempt)
+run_bench phH_vit_small 1800 pinned BENCH_ARCH=vit_small BENCH_BATCH=32
+run_bench phH_vit_base  1800 pinned BENCH_ARCH=vit_base  BENCH_BATCH=16
+
+# wedge-prone giant compiles after everything cheap; scanned blocks on
+# BOTH sides of the A/B keep the HLO ~24x smaller (the unscanned 512px
+# flash compile exceeded 35 min and wedged the tunnel in r3)
+run_bench phF_hr512_auto 3600 pinned BENCH_RES=512 BENCH_BATCH=2 \
+    BENCH_OVERRIDES=train.scan_layers=true
+run_bench phF_hr512_xla  3600 pinned BENCH_RES=512 BENCH_BATCH=2 \
+    BENCH_OVERRIDES=kernels.flash_attention=xla,train.scan_layers=true
+run_bench phF_hr768_auto 3900 pinned BENCH_RES=768 BENCH_BATCH=1 \
+    BENCH_OVERRIDES=train.scan_layers=true
+run_bench phF_hr768_xla  3900 pinned BENCH_RES=768 BENCH_BATCH=1 \
+    BENCH_OVERRIDES=kernels.flash_attention=xla,train.scan_layers=true
+
+# phE last: the ViT-S accuracy rung (hours of tunnel time, lowest
+# marginal evidence per hour). Texture dataset, full recipe vs no_ibot
+# at real width — the scale-dependence question from VERDICT r4 weak #3.
+gate_phase 11400 phE_vits_textures && {
+    note "start phE_vits_textures"
+    if ABL_ARCH=vit_small ABL_ARMS=full,no_ibot \
+            ABL_STEPS=3000 ABL_EVAL_EVERY=200 ABL_BATCH=48 \
+            timeout 10800 python scripts/ablation_recipe.py /tmp/abl_vits \
+            >> "$LOG" 2>&1; then
+        note "done  phE_vits_textures -> /tmp/abl_vits/ABLATION.json"
+    else
+        note "FAIL  phE_vits_textures rc=$?"
+    fi
+}
+
+note "=== r5 queue complete; results in $RESULTS ==="
