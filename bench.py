@@ -101,6 +101,11 @@ def _maybe_stall_probe(state: dict, stall_after: float,
         # "two consecutive failures" this detector promises)
         state["phase"] = _PHASE["name"]
         state["fails"] = 0
+    # a cold REMOTE compile legitimately runs many minutes (and a busy
+    # tunnel may answer a fresh probe slowly), so compile gets 4x the
+    # stall threshold; warmup/measure are seconds-long when healthy
+    if _PHASE["name"] == "compile":
+        stall_after = 4.0 * stall_after
     if time.time() - _PHASE["since"] < stall_after or not _tpu_required():
         return
     # healthy probes re-arm only once per stall_after window; FAILED
@@ -400,8 +405,9 @@ def _supervise() -> int:
         # applies to budget shrinkage: a caller-chosen BENCH_ATTEMPT_
         # TIMEOUT below the floor is a conscious trade (smoke/test runs).
         init_r = int(env["BENCH_INIT_RETRIES"])
+        # backoff doubles from 20s: total sleep = 20*(2^r - 1), not 20*r
         infra_floor = ((init_r + 1) * float(env["BENCH_PROBE_TIMEOUT"])
-                       + 20.0 * init_r + 90.0)
+                       + 20.0 * (2 ** init_r - 1) + 90.0)
         if eff_tmo < min(tmo, infra_floor):
             _log(f"supervisor: remaining budget ({remaining:.0f}s) is "
                  f"below the child's infra-detection floor "

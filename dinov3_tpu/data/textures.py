@@ -139,9 +139,20 @@ def materialize_textures(root: str, n_train_per_class: int = 150,
     if os.path.isfile(manifest_path):
         import json
 
-        with open(manifest_path) as f:
-            if json.load(f) == manifest:
-                return train_dir, val_dir
+        try:
+            with open(manifest_path) as f:
+                if json.load(f) == manifest:
+                    return train_dir, val_dir
+        except ValueError:
+            pass  # truncated manifest (killed mid-write): regenerate
+    # remove the stale manifest FIRST (ADVICE r4): if a regeneration is
+    # killed mid-write, a surviving manifest would still describe the
+    # previous complete run, and a later invocation with the OLD
+    # parameters would match it and silently reuse the partial tree
+    try:
+        os.remove(manifest_path)
+    except OSError:
+        pass
     for d in (train_dir, val_dir):
         shutil.rmtree(d, ignore_errors=True)
     rng = np.random.default_rng(seed)
@@ -156,6 +167,8 @@ def materialize_textures(root: str, n_train_per_class: int = 150,
                 Image.fromarray(img).save(os.path.join(cls_dir, f"{i}.png"))
     import json
 
-    with open(manifest_path, "w") as f:
+    # atomic: a kill mid-dump must never leave a truncated manifest
+    with open(manifest_path + ".tmp", "w") as f:
         json.dump(manifest, f)
+    os.replace(manifest_path + ".tmp", manifest_path)
     return train_dir, val_dir

@@ -146,13 +146,16 @@ def main():
         replaced = {record_name(a, arch) for a in arms}
 
         def _stale(rec: dict) -> bool:
-            # also drop OLD-format records written by the pre-suffix
-            # revision: bare arm name at a non-default arch whose
-            # recorded arch metadata matches this invocation — they are
-            # the same (arm, arch) cell and must be replaced, not kept
-            # as a second ambiguous entry
-            return (rec["arm"] in replaced
-                    or (rec["arm"] in arms and rec.get("arch") == arch))
+            # a record is replaced only when BOTH its arm key and its
+            # recorded arch match this invocation's (arm, arch) cell:
+            # the arch guard keeps a default-arch rerun from deleting an
+            # old-format bare-name record that was written at a
+            # DIFFERENT arch (a distinct cell). Also drop OLD-format
+            # records from the pre-suffix revision (bare arm name at a
+            # non-default arch) when their arch metadata matches.
+            rec_arch = rec.get("arch", DEFAULT_ARCH)
+            return ((rec["arm"] in replaced and rec_arch == arch)
+                    or (rec["arm"] in arms and rec_arch == arch))
 
         try:
             with open(art_path) as f:

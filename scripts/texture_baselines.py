@@ -66,13 +66,14 @@ def main():
 
     xtr, ytr = load_split(train_dir)
     xva, yva = load_split(val_dir)
-    # the eval harness's loaders use drop_last=True at batch 64, so the
-    # trajectory numbers see only the first floor(N/64)*64 samples in
-    # dataset order — evaluate the pixel floor on the SAME population
-    n_tr = (len(xtr) // 64) * 64 or len(xtr)
-    n_va = (len(xva) // 64) * 64 or len(xva)
-    pixel_knn = knn_eval(xtr[:n_tr], ytr[:n_tr], xva[:n_va], yva[:n_va],
-                         n_classes=12, k=10)
+    # population note (ADVICE r4): the eval harness's loaders shuffle
+    # (seeded) BEFORE drop_last=True at batch 64, so the trajectory
+    # numbers see a random subset with the tail dropped — NOT a prefix
+    # in dataset order. Rather than replicate the loader's shuffle here,
+    # the pixel floor is computed on ALL samples; the difference is the
+    # dropped tail (< one batch per split, ~8 of 360 val samples) and is
+    # negligible for a chance-floor calibration.
+    pixel_knn = knn_eval(xtr, ytr, xva, yva, n_classes=12, k=10)
 
     # untrained backbone through the SAME eval harness the trajectories
     # use — the iteration-0 point of every committed curve. The shared
