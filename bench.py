@@ -257,6 +257,38 @@ def _split_overrides(s: str) -> list[str]:
     return out
 
 
+def build_step_overrides(arch: str, res: int, *,
+                         drop_path_mode: str | None = None,
+                         probs: str | None = None,
+                         extra=()) -> list[str]:
+    """The exact dot-override list that defines the bench step program.
+
+    Single source of truth shared with scripts/count_flops.py so the
+    counted-FLOP ceilings are always ceilings OF THE BENCHED PROGRAM —
+    the r3 13.31-vs-13.68 discrepancy came from a drifted ad-hoc copy
+    of this list."""
+    overrides = [
+        f"student.arch={arch}",
+        "student.n_storage_tokens=4",
+        "student.drop_path_rate=0.3",
+        "optim.scaling_rule=none",
+        "parallel.data=-1",
+        # the recipe's ``param_dtype: bf16`` (vitl_im1k_lin834.yaml) is the
+        # torch-FSDP compute-copy dtype; training masters are always fp32
+        # (ssl_meta_arch.py) and compute runs in compute_dtype=bf16, so the
+        # override is kept only for recipe-key parity
+        "compute_precision.param_dtype=bf16",
+    ]
+    if drop_path_mode:
+        overrides.append(f"student.drop_path_mode={drop_path_mode}")
+    if res:
+        overrides += [f"crops.global_crops_size={res}",
+                      f"crops.local_crops_size={max(96, res // 4)}"]
+    if probs:
+        overrides.append(f"compute_precision.probs_dtype={probs}")
+    return overrides + list(extra)
+
+
 _CURRENT_CHILD = {"proc": None}
 
 
@@ -502,26 +534,11 @@ def main():
 
     _phase("build")
     cfg = get_default_config()
-    overrides = [
-        f"student.arch={arch}",
-        "student.n_storage_tokens=4",
-        "student.drop_path_rate=0.3",
-        "optim.scaling_rule=none",
-        "parallel.data=-1",
-        # the recipe's ``param_dtype: bf16`` (vitl_im1k_lin834.yaml) is the
-        # torch-FSDP compute-copy dtype; training masters are always fp32
-        # (ssl_meta_arch.py) and compute runs in compute_dtype=bf16, so the
-        # override is kept only for recipe-key parity
-        "compute_precision.param_dtype=bf16",
-    ]
-    if res:
-        overrides += [f"crops.global_crops_size={res}",
-                      f"crops.local_crops_size={max(96, res // 4)}"]
-    if os.environ.get("BENCH_PROBS"):
-        overrides.append(
-            f"compute_precision.probs_dtype={os.environ['BENCH_PROBS']}")
-    if os.environ.get("BENCH_OVERRIDES"):
-        overrides += _split_overrides(os.environ["BENCH_OVERRIDES"])
+    overrides = build_step_overrides(
+        arch, res,
+        probs=os.environ.get("BENCH_PROBS") or None,
+        extra=_split_overrides(os.environ.get("BENCH_OVERRIDES", "")),
+    )
     apply_dot_overrides(cfg, overrides)
     B = per_chip * n
     batch_np = make_synthetic_batch(cfg, B, seed=0)

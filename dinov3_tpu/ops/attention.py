@@ -47,7 +47,7 @@ def _softmax_lowp(logits, out_dtype):
     accumulates in fp32. Committed A/B on the fp32-master program:
     47.58 -> 48.07 img/s/chip (BENCH_r03_phases.jsonl, bf16 vs fp32
     probs storage); the per-layer breakdown awaits the committed phD
-    profile artifact (scripts/r4_queue.sh).
+    profile artifact (scripts/r5_queue.sh phD).
     """
     return jax.nn.softmax(logits, axis=-1).astype(out_dtype)
 

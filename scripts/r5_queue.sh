@@ -147,9 +147,11 @@ run_bench phF_hr512_auto 3600 pinned BENCH_RES=512 BENCH_BATCH=2 \
     BENCH_OVERRIDES=train.scan_layers=true
 run_bench phF_hr512_xla  3600 pinned BENCH_RES=512 BENCH_BATCH=2 \
     BENCH_OVERRIDES=kernels.flash_attention=xla,train.scan_layers=true
-run_bench phF_hr768_auto 3900 pinned BENCH_RES=768 BENCH_BATCH=1 \
+# B=2, not 1: KoLeo needs >=2 samples per group, so a B=1 program fails
+# at build (found via the host-side FLOP count of the same program)
+run_bench phF_hr768_auto 3900 pinned BENCH_RES=768 BENCH_BATCH=2 \
     BENCH_OVERRIDES=train.scan_layers=true
-run_bench phF_hr768_xla  3900 pinned BENCH_RES=768 BENCH_BATCH=1 \
+run_bench phF_hr768_xla  3900 pinned BENCH_RES=768 BENCH_BATCH=2 \
     BENCH_OVERRIDES=kernels.flash_attention=xla,train.scan_layers=true
 
 # phE last: the ViT-S accuracy rung (hours of tunnel time, lowest

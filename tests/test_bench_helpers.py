@@ -245,3 +245,24 @@ def test_supervise_budget_below_infra_floor_is_attributable(
     assert "infra-detection floor" in rec["skipped"]
     assert rec["value"] is None
     assert time.time() - t0 < 30  # no child was ever spawned
+
+
+def test_build_step_overrides_shared_contract():
+    """scripts/count_flops.py counts FLOPs of bench.py's exact program
+    through this builder — its env-independent output is the contract."""
+    ov = bench.build_step_overrides("vit_large", 0)
+    assert "student.arch=vit_large" in ov
+    assert "student.n_storage_tokens=4" in ov
+    assert not any(o.startswith("crops.") for o in ov)
+    assert not any("drop_path_mode" in o for o in ov)  # config default rules
+    ov = bench.build_step_overrides(
+        "vit_large", 512, drop_path_mode="mask", probs="fp32",
+        extra=["train.scan_layers=false"])
+    assert "crops.global_crops_size=512" in ov
+    assert "crops.local_crops_size=128" in ov
+    assert "student.drop_path_mode=mask" in ov
+    assert "compute_precision.probs_dtype=fp32" in ov
+    assert ov[-1] == "train.scan_layers=false"
+    # 768px: local crops floor at 96*2=192? no — max(96, 768//4)=192
+    ov = bench.build_step_overrides("vit_large", 768)
+    assert "crops.local_crops_size=192" in ov
