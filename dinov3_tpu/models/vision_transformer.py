@@ -430,6 +430,9 @@ vit_7b = _ctor(4096, 40, 32, 3.0)
 vit_test = _ctor(64, 2, 2, 2.0)
 vit_test_big = _ctor(96, 3, 2, 2.0)
 vit_test4 = _ctor(64, 4, 2, 2.0)
+# same depth as vit_test4 at 2x width / 4 heads: the capacity axis of
+# the loss-factorial ablations with depth held fixed
+vit_test_wide = _ctor(128, 4, 4, 2.0)
 vit_test40 = _ctor(64, 40, 2, 3.0)
 
 ARCHS = {
@@ -437,5 +440,5 @@ ARCHS = {
     "vit_so400m": vit_so400m, "vit_huge2": vit_huge2,
     "vit_giant2": vit_giant2, "vit_7b": vit_7b, "vit_test": vit_test,
     "vit_test_big": vit_test_big, "vit_test4": vit_test4,
-    "vit_test40": vit_test40,
+    "vit_test_wide": vit_test_wide, "vit_test40": vit_test40,
 }

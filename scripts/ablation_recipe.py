@@ -176,9 +176,12 @@ def main():
                 "dataset": "procedural textures, 12 classes = motif x "
                            "frequency-band, per-image palette "
                            f"({12 * n_train} train / {12 * n_val} val "
-                           "PNGs, folder backend; per-arm metadata in "
-                           "each arm record)",
-                "arch": arch, "steps": steps, "batch": batch,
+                           "PNGs, folder backend; eval batches are 64 "
+                           "with drop_last, so metrics are over 320 of "
+                           "the 360 val images)",
+                # no top-level arch/steps/batch: the merged artifact can
+                # span invocations with different settings — the per-arm
+                # records are authoritative (r5 code review)
                 "arms": results,
             }, f, indent=2)
         os.replace(tmp_path, art_path)
