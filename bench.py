@@ -38,10 +38,11 @@ Exit codes: 0 = measured; 2 = every ladder rung failed on the program
 itself; 3 = backend unreachable (tunnel down — infra, retry later);
 5 = total budget exhausted mid-ladder. 3 and 5 still print a JSON line.
 
-Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 8 — the
-round-1 sweep's peak; those sweeps ran with bf16 masters, so the absolute
-numbers are ~20% optimistic vs today's fp32-master program — see
-MEASUREMENTS_r3.md; the B=10/B=12 re-sweep is queued in scripts/r4_queue.sh),
+Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 12 — the
+round-5 on-chip sweep's peak for the subset drop-path program:
+58.56 img/s/chip at B=12 vs 54.46 at B=8 and a pathological 24.22 at
+B=10, BENCH_r05_phases.jsonl; the old B=8 default was the round-1
+bf16-master peak),
 BENCH_STEPS (10), BENCH_WARMUP (3), BENCH_RES (high-res crop px).
 """
 
@@ -520,7 +521,7 @@ def main():
     from dinov3_tpu.train import build_train_setup, put_batch
 
     arch = os.environ.get("BENCH_ARCH", "vit_large")
-    per_chip = int(os.environ.get("BENCH_BATCH", "8"))
+    per_chip = int(os.environ.get("BENCH_BATCH", "12"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     res = int(os.environ.get("BENCH_RES", "0"))  # >0: global crop px

@@ -27,8 +27,13 @@ def softmax_center_teacher(
     teacher_logits: jnp.ndarray,
     center: jnp.ndarray,
     teacher_temp: float | jnp.ndarray,
+    storage_dtype=None,
 ) -> jnp.ndarray:
-    return jax.nn.softmax((teacher_logits - center) / teacher_temp, axis=-1)
+    """The softmax runs in fp32 (the fp32 center promotes the logits
+    inside the fusion); ``storage_dtype`` types only the materialized
+    [*, K] target buffer (compute_precision.target_dtype)."""
+    p = jax.nn.softmax((teacher_logits - center) / teacher_temp, axis=-1)
+    return p if storage_dtype is None else p.astype(storage_dtype)
 
 
 def update_center(
@@ -36,8 +41,13 @@ def update_center(
     teacher_logits: jnp.ndarray,
     momentum: float = 0.9,
 ) -> jnp.ndarray:
-    """EMA center update; mean over the global batch (reference:91-95)."""
-    batch_center = jnp.mean(teacher_logits, axis=0, keepdims=True)
+    """EMA center update; mean over the global batch (reference:91-95).
+
+    Accumulates fp32 whatever the logits' storage dtype — the center is
+    fp32 EMA state.
+    """
+    batch_center = jnp.mean(teacher_logits, axis=0, keepdims=True,
+                            dtype=jnp.float32)
     return center * momentum + batch_center * (1.0 - momentum)
 
 
@@ -62,7 +72,10 @@ def dino_loss(
     x = student_logits / student_temp
     lse = jax.scipy.special.logsumexp(
         x.astype(jnp.float32), axis=-1)                      # [S, B]
-    qsum = jnp.sum(teacher_probs, axis=-1)                   # [T, B]
+    # fp32 accumulation regardless of the probs' storage dtype (bf16
+    # targets under compute_precision.target_dtype would otherwise
+    # accumulate 65k terms in bf16)
+    qsum = jnp.sum(teacher_probs, axis=-1, dtype=jnp.float32)  # [T, B]
     dot = jnp.einsum("sbk,tbk->st", x, teacher_probs,
                      preferred_element_type=jnp.float32)
     corr = jnp.einsum("sb,tb->st", lse, qsum)

@@ -50,10 +50,12 @@ def ibot_patch_loss_masked(
     # exists; x is read in its storage dtype with fp32 accumulation.
     x = student_logits / student_temp
     lse = jax.scipy.special.logsumexp(x.astype(jnp.float32), axis=-1)  # [M]
-    # bf16 x * fp32 q promotes elementwise inside the fused reduction —
-    # no fp32 copy of x is materialized
-    dot = jnp.sum(teacher_probs * x, axis=-1)                          # [M]
-    per_token = dot - jnp.sum(teacher_probs, axis=-1) * lse
+    # q * x promotes elementwise inside the fused reduction (no fp32 copy
+    # of x is materialized); the reduction itself always accumulates fp32
+    # even when both operands are bf16 (compute_precision.target_dtype)
+    dot = jnp.sum(teacher_probs * x, axis=-1, dtype=jnp.float32)       # [M]
+    per_token = dot - jnp.sum(teacher_probs, axis=-1,
+                              dtype=jnp.float32) * lse
     return -jnp.sum(per_token * masks_weight) / max(n_images, 1)
 
 

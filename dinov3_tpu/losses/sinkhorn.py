@@ -25,6 +25,7 @@ def sinkhorn_knopp(
     n_iterations: int = 3,
     row_weights: jnp.ndarray | None = None,
     reduce_dtype=jnp.float32,
+    storage_dtype=None,
 ) -> jnp.ndarray:
     """Sinkhorn-normalized teacher targets.
 
@@ -32,6 +33,12 @@ def sinkhorn_knopp(
     the padded masked-token buffer for iBOT).
     row_weights: optional [B] 0/1 validity; the effective sample count is
     ``sum(row_weights)`` (the reference's ``n_masked_patches`` psum).
+    storage_dtype: dtype of the materialized [B, K] buffers (the
+    normalized-logit iterate and the returned targets). ``None`` keeps
+    them in ``reduce_dtype``. bf16 halves the HBM traffic of the
+    dominant loss-side tensors (r5 on-chip profile); every logsumexp
+    still reduces in ``reduce_dtype`` — the storage read upcasts inside
+    the fused reduction, so nothing fp32-sized is materialized.
     Returns [B, K] assignment probabilities (each valid row sums to 1).
     """
     B, K = logits.shape
@@ -58,6 +65,7 @@ def sinkhorn_knopp(
         log_B = jnp.log(jnp.asarray(B, reduce_dtype))
         row_pad = None
 
+    store = storage_dtype or reduce_dtype
     xf = x.astype(reduce_dtype)
     if row_pad is not None:
         xf = xf + row_pad[:, None]
@@ -65,8 +73,10 @@ def sinkhorn_knopp(
     # magnitude, which keeps the offset subtractions below full-precision
     # ulp — iterating offsets against raw logits would re-incur
     # |logits/T|-scale rounding on every pass); everything after is
-    # read-only against xs.
-    xs = xf - jax.nn.logsumexp(xf)
+    # read-only against xs. The normalization itself runs in reduce_dtype
+    # (the fp32 intermediates live only inside XLA fusions); only the
+    # iterate's storage is ``store``-typed.
+    xs = (xf - jax.nn.logsumexp(xf)).astype(store)
     r = jnp.zeros((B, 1), reduce_dtype)   # row offsets
     c = jnp.zeros((1, K), reduce_dtype)   # column offsets
     log_K = jnp.log(jnp.asarray(K, reduce_dtype))
@@ -80,8 +90,8 @@ def sinkhorn_knopp(
             # contribute nothing to later column reductions
             dr = jnp.where(valid[:, None], dr, 0.0)
         r = r + dr
-    log_q = xs - r - c
-    q = jnp.exp(log_q + log_B)  # each valid row sums to 1
+    log_q = xs - r - c  # promotes to reduce_dtype inside the fusion
+    q = jnp.exp(log_q + log_B).astype(store)  # each valid row sums to 1
     if valid is not None:
-        q = jnp.where(valid[:, None], q, 0.0)
+        q = jnp.where(valid[:, None], q, jnp.zeros((), store))
     return q

@@ -45,15 +45,24 @@ class Policy:
     compute_dtype: Any = jnp.bfloat16
     reduce_dtype: Any = jnp.float32
     probs_dtype: Any = None  # attention-probability storage; None = reduce
+    # Teacher-target storage (sinkhorn/softmax-centered [*, K] probability
+    # buffers over the 65k-262k prototype heads). None = reduce_dtype
+    # (fp32, the reference numerics). bf16 halves the HBM traffic of the
+    # largest loss-side tensors; every reduction over them still
+    # accumulates in fp32 (r5 profile: these fp32 passes were 10.2% of
+    # device step time, PROFILE_r05.json).
+    target_dtype: Any = None
 
     @classmethod
     def from_cfg(cls, precision_cfg) -> "Policy":
         probs = precision_cfg.get("probs_dtype")
+        target = precision_cfg.get("target_dtype")
         return cls(
             param_dtype=canonical_dtype(precision_cfg.get("param_dtype", "fp32")),
             compute_dtype=canonical_dtype(precision_cfg.get("compute_dtype", "bf16")),
             reduce_dtype=canonical_dtype(precision_cfg.get("reduce_dtype", "fp32")),
             probs_dtype=canonical_dtype(probs) if probs else None,
+            target_dtype=canonical_dtype(target) if target else None,
         )
 
 

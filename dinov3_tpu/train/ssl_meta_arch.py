@@ -293,19 +293,27 @@ class SSLMetaArch:
         valid = batch["mask_valid"].reshape(-1)
 
         new_state = dict(state)
+        # Teacher-target storage dtype: bf16 halves the HBM footprint of
+        # the [*, 65536] target buffers (10.2% of the r5 on-chip step
+        # profile was fp32 passes over them); reductions stay fp32.
+        tgt = self.policy.target_dtype
         if self.centering == "sinkhorn_knopp":
-            cls_centered = sinkhorn_knopp(cls_logits, teacher_temp)
+            cls_centered = sinkhorn_knopp(
+                cls_logits, teacher_temp, storage_dtype=tgt)
             masked_centered = sinkhorn_knopp(
                 masked_logits, teacher_temp,
                 row_weights=valid.astype(self.policy.reduce_dtype),
+                storage_dtype=tgt,
             )
         elif self.centering == "softmax_center":
             cls_centered = softmax_center_teacher(
-                cls_logits, state["dino_center"], teacher_temp
+                cls_logits, state["dino_center"], teacher_temp,
+                storage_dtype=tgt,
             )
             masked_centered = softmax_center_teacher(
-                masked_logits, state["ibot_center"], teacher_temp
-            ) * valid[:, None]
+                masked_logits, state["ibot_center"], teacher_temp,
+                storage_dtype=tgt,
+            ) * valid[:, None].astype(tgt or masked_logits.dtype)
             if update_centers:
                 new_state["dino_center"] = update_center(
                     state["dino_center"], cls_logits

@@ -1,0 +1,89 @@
+"""Distill a jax.profiler trace (trace-viewer JSON) into a committed artifact.
+
+Reads the ``vm.trace.json.gz`` that ``scripts/profile_step.py`` leaves under
+``<outdir>/plugins/profile/<ts>/`` and writes one JSON document with:
+
+- per-step device time (XLA Modules lane),
+- op-kind buckets (uniquifying suffixes stripped) with time/count/share,
+- the top-N exact op instances with their HLO result shapes, so "which
+  tensor is this pass over" is answerable from the artifact alone.
+
+Usage: python scripts/distill_trace.py <trace.json.gz> [out.json]
+"""
+
+from __future__ import annotations
+
+import collections
+import gzip
+import json
+import re
+import sys
+
+
+def distill(trace_path: str, top_n: int = 40) -> dict:
+    with gzip.open(trace_path) as f:
+        ev = json.load(f)["traceEvents"]
+    # device lanes: pid of the process named /device:TPU:0
+    dev_pids = {e["pid"] for e in ev
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "/device" in e.get("args", {}).get("name", "")}
+    lanes = {}
+    for e in ev:
+        if (e.get("ph") == "M" and e.get("name") == "thread_name"
+                and e.get("pid") in dev_pids):
+            lanes[(e["pid"], e["tid"])] = e["args"]["name"]
+    ops = [e for e in ev if e.get("ph") == "X"
+           and lanes.get((e.get("pid"), e.get("tid"))) == "XLA Ops"]
+    mods = [e for e in ev if e.get("ph") == "X"
+            and lanes.get((e.get("pid"), e.get("tid"))) == "XLA Modules"]
+
+    total_us = sum(e["dur"] for e in ops)
+    buckets = collections.Counter()
+    counts = collections.Counter()
+    exact = collections.Counter()
+    meta = {}
+    for e in ops:
+        kind = re.sub(r"[.\d]+$", "", e["name"])
+        buckets[kind] += e["dur"]
+        counts[kind] += 1
+        exact[e["name"]] += e["dur"]
+        if e["name"] not in meta:
+            ln = e.get("args", {}).get("long_name", "")
+            # keep just "%name = <result shape(s)>" — enough to identify
+            # the tensor without embedding the whole HLO line
+            meta[e["name"]] = ln.split(" fusion(")[0].split(" custom-call(")[0][:160]
+
+    return {
+        "trace": trace_path,
+        "n_device_ops": len(ops),
+        "steps": [{"name": m["name"].split("(")[0], "ms": round(m["dur"] / 1e3, 2)}
+                  for m in mods],
+        "device_total_ms": round(total_us / 1e3, 1),
+        "buckets": [
+            {"kind": k, "ms": round(v / 1e3, 1),
+             "share": round(v / total_us, 4), "count": counts[k]}
+            for k, v in buckets.most_common()
+        ],
+        "top_ops": [
+            {"name": k, "ms": round(v / 1e3, 2),
+             "share": round(v / total_us, 4), "hlo": meta[k]}
+            for k, v in exact.most_common(top_n)
+        ],
+    }
+
+
+def main():
+    trace = sys.argv[1]
+    out = sys.argv[2] if len(sys.argv) > 2 else "PROFILE.json"
+    doc = distill(trace)
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    b = doc["buckets"]
+    print(f"device total {doc['device_total_ms']} ms over {len(doc['steps'])} modules")
+    for row in b[:12]:
+        print(f"{row['ms']:9.1f} ms {100 * row['share']:5.1f}% n={row['count']:6d} {row['kind']}")
+    print("->", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -53,7 +53,7 @@ def main():
 
     outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/prof_r2"
     arch = os.environ.get("BENCH_ARCH", "vit_large")
-    per_chip = int(os.environ.get("BENCH_BATCH", "8"))
+    per_chip = int(os.environ.get("BENCH_BATCH", "12"))  # bench.py default
     res = int(os.environ.get("BENCH_RES", "0"))
 
     n = jax.device_count()
