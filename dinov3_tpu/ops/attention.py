@@ -46,8 +46,10 @@ def _softmax_lowp(logits, out_dtype):
     (bf16) halves that traffic; the backward (dL = p * (g - sum(g*p)))
     accumulates in fp32. Committed A/B on the fp32-master program:
     47.58 -> 48.07 img/s/chip (BENCH_r03_phases.jsonl, bf16 vs fp32
-    probs storage); the per-layer breakdown awaits the committed phD
-    profile artifact (scripts/r5_queue.sh phD).
+    probs storage); the r5 on-chip profile (PROFILE_r05.json) confirms
+    the residual copies survive as the f32 `[11,16,201,201]` copy ops
+    (~1% of step) — the bf16 residual is what keeps them there and not
+    at 2x that.
     """
     return jax.nn.softmax(logits, axis=-1).astype(out_dtype)
 

@@ -1,0 +1,133 @@
+#!/bin/bash
+# Round-5 follow-up queue, armed after the tunnel's 50-minute revival
+# window (15:31-16:21 UTC Aug 2) banked phA/phB/phC/phD/phG/phH/phF and
+# then died mid-phE. Phases here are what that window left, cheapest /
+# highest-evidence first:
+#   phG2  re-run op-level flash-vs-dense crossover with the FIXED
+#         fetch-sync harness (the first pass measured enqueue only)
+#   phT   target_dtype=bf16 A/B vs the committed B=12 default
+#   phC16 B=16 sweep point (B=12 default beat B=8 by 7.5%)
+#   phE2  ViT-S texture rung, full + no_ibot arms (arm 1 died at
+#         iter ~1000/3000 when the tunnel went down)
+#
+# Usage: bash scripts/r5b_queue.sh  (env: RESULTS, QUEUE_LOG, DEADLINE_HOURS)
+
+set -u
+cd "$(dirname "$0")/.."
+RESULTS="${RESULTS:-/tmp/r5b_results.jsonl}"
+LOG="${QUEUE_LOG:-/tmp/r5b_queue.log}"
+DEADLINE=$(( $(date +%s) + ${DEADLINE_HOURS:-10} * 3600 ))
+
+note() { echo "[r5b $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+remaining() { echo $(( DEADLINE - $(date +%s) )); }
+
+probe() {
+    timeout 300 python - <<'EOF' >>"$LOG" 2>&1
+import sys
+sys.path.insert(0, ".")
+from dinov3_tpu.utils import respect_jax_platforms_env
+respect_jax_platforms_env()
+import jax
+assert jax.default_backend() != "cpu", "fell back to cpu"
+print("PROBE-OK", jax.device_count())
+EOF
+}
+
+wait_healthy() {
+    while [ "$(remaining)" -gt 0 ]; do
+        if probe; then note "probe healthy"; return 0; fi
+        note "probe unhealthy; sleeping 240s ($(( $(remaining) / 60 )) min to deadline)"
+        sleep 240
+    done
+    note "deadline reached while waiting for a healthy tunnel"
+    return 1
+}
+
+gate_phase() {
+    local backstop="$1" tag="$2"
+    if [ "$(remaining)" -le "$backstop" ]; then
+        note "SKIP $tag: ${backstop}s backstop does not fit in $(remaining)s to deadline"
+        return 1
+    fi
+    wait_healthy || return 1
+    if [ "$(remaining)" -le "$backstop" ]; then
+        note "SKIP $tag: deadline closed in while waiting for a healthy probe"
+        return 1
+    fi
+    return 0
+}
+
+run_bench() {
+    local tag="$1" tmo="$2" kind="$3"; shift 3
+    local backstop budget
+    if [ "$kind" = pinned ]; then
+        budget=$tmo; backstop=$((tmo + 600))
+    else
+        budget=$((3 * tmo)); backstop=$((3 * tmo + 600))
+    fi
+    local try rc out
+    for try in 1 2; do
+        gate_phase "$backstop" "$tag" || return 1
+        note "start $tag try=$try (tmo=${tmo}s budget=${budget}s) env: $*"
+        out=$(env "$@" BENCH_ATTEMPT_TIMEOUT="$tmo" BENCH_TOTAL_BUDGET="$budget" \
+              timeout "$backstop" python bench.py 2>>"$LOG")
+        rc=$?
+        if [ $rc -eq 0 ] && [ -n "$out" ]; then
+            echo "{\"tag\": \"$tag\", \"rc\": 0, \"result\": $out}" >> "$RESULTS"
+            note "done  $tag -> $out"
+            return 0
+        fi
+        if [ -n "$out" ]; then
+            echo "{\"tag\": \"$tag\", \"rc\": $rc, \"result\": $out}" >> "$RESULTS"
+        else
+            echo "{\"tag\": \"$tag\", \"rc\": $rc, \"result\": null}" >> "$RESULTS"
+        fi
+        if [ $rc -eq 3 ] && [ $try -eq 1 ]; then
+            note "INFRA $tag rc=3 (tunnel died mid-run); re-gating on probe for one retry"
+            continue
+        fi
+        note "FAIL  $tag rc=$rc"
+        return $rc
+    done
+}
+
+note "=== r5b queue starting; deadline $(date -d @$DEADLINE +%H:%M:%S) ==="
+
+# phG2: the fixed crossover (sync via value fetch). Minutes of chip time.
+gate_phase 2400 phG2_attn_crossover && {
+    note "start phG2_attn_crossover"
+    rm -f /tmp/attn_crossover_fixed.jsonl
+    if timeout 2400 python scripts/bench_attention_crossover.py \
+            /tmp/attn_crossover_fixed.jsonl >> "$LOG" 2>&1; then
+        note "done  phG2_attn_crossover -> /tmp/attn_crossover_fixed.jsonl"
+    else
+        note "FAIL  phG2_attn_crossover rc=$?"
+    fi
+}
+
+# phT: teacher-target bf16 storage A/B against the committed B=12
+# default (54.46->58.56 was the B sweep; this isolates target_dtype).
+# Pinned: a ladder substitution would invalidate the A/B.
+run_bench phT_target_bf16 2100 pinned \
+    BENCH_OVERRIDES=compute_precision.target_dtype=bf16
+# control re-run in the same session so the A/B shares a host
+run_bench phT_target_fp32_ctl 2100 pinned BENCH_PROBS=bf16
+
+# phC16: the sweep's missing point above the new default
+run_bench phC_b16 2100 pinned BENCH_BATCH=16 BENCH_PROBS=bf16
+
+# phE2: the ViT-S accuracy rung (hours; lowest marginal evidence/hour).
+gate_phase 11400 phE2_vits_textures && {
+    note "start phE2_vits_textures"
+    if ABL_ARCH=vit_small ABL_ARMS=full,no_ibot \
+            ABL_STEPS=3000 ABL_EVAL_EVERY=200 ABL_BATCH=48 \
+            timeout 10800 python scripts/ablation_recipe.py /tmp/abl_vits \
+            >> "$LOG" 2>&1; then
+        note "done  phE2_vits_textures -> /tmp/abl_vits/ABLATION.json"
+    else
+        note "FAIL  phE2_vits_textures rc=$?"
+    fi
+}
+
+note "=== r5b queue complete; results in $RESULTS ==="
