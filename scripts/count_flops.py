@@ -33,6 +33,9 @@ POINTS = {
     # default bench program (ViT-L/16, B=8, 224px + 8x96px)
     "vitl_mask": ("vit_large", 8, 0, "mask", []),
     "vitl_subset": ("vit_large", 8, 0, "subset", []),
+    # the r5 default program: B=12, the on-chip sweep peak
+    # (58.56 img/s/chip, BENCH_r05_phases.jsonl)
+    "vitl_subset_b12": ("vit_large", 12, 0, "subset", []),
     # ladder points for the fp32-master BENCH_ARCH rungs (phH); the
     # _mask variants exist because the r1 bf16-master measurements ran
     # the mask program — utilization comparisons must divide them by
