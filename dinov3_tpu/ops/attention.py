@@ -125,11 +125,15 @@ def _flash_available() -> bool:
 
 # Below this many tokens the dense-softmax XLA path wins on TPU: the whole
 # [N, N] fits in VMEM, XLA fuses RoPE/scale/softmax into the matmuls, and
-# the flash kernel's custom_vjp would block those fusions (measured ~1.45x
-# full-train-step slowdown for ViT-L at N=201 on v5e). Flash takes over
-# where its O(N) memory matters: high-res (518-768px -> 1029-2309 tokens)
-# and ViT-7B.
-FLASH_MIN_SEQ = 1024
+# the flash kernel's custom_vjp would block those fusions. Measured
+# full-train-step evidence (v5e): dense wins at N=201 (~1.45x, r1) AND at
+# N=1029 — the 512px ViT-L step runs 9.99 img/s dense vs 7.65 flash
+# (BENCH_r05_phases.jsonl phF), so the old 1024 threshold flipped to the
+# slower path at its first live decision point. 2048 keeps every measured
+# regime on dense while leaving flash reachable where its O(N) memory is
+# the point (768px -> 2309 tokens, ViT-7B long-context); the 2309+ side
+# is pending the fixed op-level crossover (scripts/r5b_queue.sh phG2).
+FLASH_MIN_SEQ = 2048
 
 
 def dispatch_attention(

@@ -97,3 +97,43 @@ def test_flash_block_caps_honored():
                               "kernels.flash_block_q=256"])
     kw = backbone_kwargs_from_cfg(cfg)
     assert kw["flash_block_q"] == 256 and kw["flash_block_kv"] == 512
+
+
+def test_auto_dispatch_threshold(monkeypatch):
+    """The auto dispatch keeps every *measured* regime on dense XLA.
+
+    Full-step evidence (BENCH_r05_phases.jsonl): dense beats flash at
+    N=201 (224px) and N=1029 (512px, 9.99 vs 7.65 img/s/chip), so auto
+    must choose xla there; flash stays reachable at 2309+ (768px) where
+    its O(N) memory is the point. Backend/kernel availability are
+    monkeypatched — this pins the threshold logic, not the TPU.
+    """
+    from dinov3_tpu.ops import attention as att
+
+    chosen = {}
+
+    def fake_xla(q, k, v, *a, **kw):
+        chosen["impl"] = "xla"
+        return q
+
+    def fake_flash(q, k, v, **kw):
+        chosen["impl"] = "pallas"
+        return q
+
+    monkeypatch.setattr(att, "xla_attention", fake_xla)
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(att, "_flash_available", lambda: True)
+    import dinov3_tpu.ops.flash_attention as fa
+
+    monkeypatch.setattr(fa, "flash_attention", fake_flash)
+
+    for N, want in [(201, "xla"), (1029, "xla"), (1054, "xla"),
+                    (2309, "pallas"), (4096, "pallas")]:
+        q = jnp.zeros((1, N, 2, 32), jnp.bfloat16)
+        att.dispatch_attention(q, q, q, impl="auto")
+        assert chosen["impl"] == want, (N, chosen["impl"], want)
+
+    # kernels.flash_min_seq override still wins over the builtin
+    q = jnp.zeros((1, 1029, 2, 32), jnp.bfloat16)
+    att.dispatch_attention(q, q, q, impl="auto", flash_min_seq=512)
+    assert chosen["impl"] == "pallas"
