@@ -24,7 +24,16 @@ fix infra, ending in rc=124 with no record):
   every 60 s — a hang in the captured tail is attributable to a phase;
 - env kill-switches bisect the step program: BENCH_PROBS=fp32|bf16
   (attention-probability storage), DINOV3_FUSED_LN=1 (Pallas layernorm),
-  BENCH_OVERRIDES=comma-separated extra dot-overrides.
+  BENCH_OVERRIDES=comma-separated extra dot-overrides (e.g.
+  optim.fused_update=false for the update-engine A/B).
+- every run measures a fixed seconds-long calibration rung (chained
+  1024x1024 bf16 matmuls) right after backend init and records it in
+  the final JSON line ("calib"), so cross-session comparisons carry a
+  measured session-health factor instead of the ~15% shrug
+  (docs/PERFORMANCE.md "Session calibration");
+- a batch-tiling guardrail warns (and records "batch_tiling_warning")
+  when BENCH_BATCH pads >20% on the sublane axis — the measured B=10
+  cliff (24.22 vs 58.56 img/s/chip at B=12).
 - failure is ATTRIBUTABLE and BOUNDED: the measurement child exits
   rc=3 when the backend is unreachable (probe hang / init fallback to
   cpu — infra, not program); the supervisor then stops the fallback
@@ -92,7 +101,7 @@ def _maybe_stall_probe(state: dict, stall_after: float,
     compile on a live tunnel is never killed (the probe spawns a fresh
     backend connection, which the axon pool accepts independently of
     the in-flight compile)."""
-    if _PHASE["name"] not in ("compile", "warmup", "measure"):
+    if _PHASE["name"] not in ("calibrate", "compile", "warmup", "measure"):
         state["fails"] = 0
         return
     if _PHASE["name"] != state.get("phase"):
@@ -236,6 +245,37 @@ def _init_backend_with_retries(jax, retries: int, backoff: float = 20.0):
     _log(f"FATAL-INFRA: backend init failed after {retries + 1} attempts: "
          f"{err}")
     sys.exit(RC_INFRA_DOWN)
+
+
+def _measure_calibration(jax, jnp) -> dict:
+    """Fixed calibration rung: a seconds-long, session-independent
+    program (chained 1024x1024 bf16 matmuls, fetch-synced) measured
+    right after backend init and recorded in the final JSON line of
+    EVERY bench run — so every phases-JSONL row a queue harness emits
+    carries a measured session-health factor. Cross-session throughput
+    comparisons can then divide out slow-session drift instead of the
+    documented ~15% shrug (r5: the same mask program measured 41.61 on
+    one host and 47.6-48.1 on another; docs/PERFORMANCE.md "Session
+    calibration")."""
+    n, iters = 1024, 10
+    x = (jnp.arange(n * n, dtype=jnp.float32).reshape(n, n)
+         / jnp.float32(n * n)).astype(jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    y = x
+    for _ in range(3):
+        y = f(y)
+    float(jnp.sum(y.astype(jnp.float32)))  # fetch-sync (not block_until_ready)
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(iters):
+        y = f(y)
+    float(jnp.sum(y.astype(jnp.float32)))
+    dt = (time.perf_counter() - t0) / iters
+    return {
+        "program": "matmul1024_bf16_chain_x10",
+        "ms_per_matmul": round(dt * 1e3, 4),
+        "tflops": round(2 * n ** 3 / dt / 1e12, 2),
+    }
 
 
 def _split_overrides(s: str) -> list[str]:
@@ -533,7 +573,14 @@ def main():
     )
     _log(f"backend={jax.default_backend()} devices={n}")
 
+    _phase("calibrate")
+    calib = _measure_calibration(jax, jnp)
+    _log(f"calibration: {calib}")
+
     _phase("build")
+    from dinov3_tpu.configs.config import warn_bad_batch_tiling
+
+    tiling_warning = warn_bad_batch_tiling(per_chip)
     cfg = get_default_config()
     overrides = build_step_overrides(
         arch, res,
@@ -589,7 +636,13 @@ def main():
         "value": round(img_s_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),
+        # session-health factor: every phases-JSONL row that embeds this
+        # record carries the fixed calibration rung (see docs/PERFORMANCE.md
+        # "Session calibration")
+        "calib": calib,
     }
+    if tiling_warning:
+        rec["batch_tiling_warning"] = tiling_warning
     if degraded:
         # distinct reasons can fire for the global- and local-crop
         # batches of the same program — keep them all

@@ -22,6 +22,29 @@ from dinov3_tpu.train.train_step import TrainState
 logger = logging.getLogger("dinov3")
 
 
+def pytree_restore_args(item, **kw):
+    """``ocp.args.PyTreeRestore`` with partial restore across orbax
+    versions: newer orbax spells it ``partial_restore=True``; older ones
+    (< 0.9) restore exactly the paths present in ``item`` when given an
+    empty ``transforms`` dict."""
+    try:
+        return ocp.args.PyTreeRestore(item, partial_restore=True, **kw)
+    except TypeError:
+        # old orbax demands restore_args mirroring the item structure
+        # alongside transforms
+        kw.setdefault(
+            "restore_args", ocp.checkpoint_utils.construct_restore_args(item)
+        )
+        return ocp.args.PyTreeRestore(item, transforms={}, **kw)
+
+
+def item_metadata_tree(manager, step: int, name: str = "state"):
+    """Tree of a checkpoint item's metadata across orbax versions (newer
+    managers wrap it in an object with a ``.tree`` attribute)."""
+    meta = manager.item_metadata(step)[name]
+    return meta.tree if hasattr(meta, "tree") else meta
+
+
 class Checkpointer:
     def __init__(
         self,
@@ -218,9 +241,7 @@ class Checkpointer:
         restored = self.manager.restore(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.PyTreeRestore(
-                    {"params": abstract}, partial_restore=True
-                )
+                state=pytree_restore_args({"params": abstract})
             ),
         )
         logger.info("restored params-only checkpoint at step %d", step)

@@ -174,6 +174,9 @@ def load_config(
         cfg.train.batch_size_per_device = cfg.train.pop("batch_size_per_gpu")
     apply_dot_overrides(cfg, overrides)
     apply_scaling_rules_to_cfg(cfg)
+    # batch-tiling guardrail: a silent 2.4x cliff is a footgun in a
+    # framework whose selling point is TPU-first layout awareness
+    warn_bad_batch_tiling(cfg.train.batch_size_per_device)
     return cfg
 
 
@@ -197,6 +200,61 @@ def data_parallel_world(cfg: ConfigNode, n_devices: int | None = None) -> int:
 
 def global_batch_size(cfg: ConfigNode, n_devices: int | None = None) -> int:
     return cfg.train.batch_size_per_device * data_parallel_world(cfg, n_devices)
+
+
+def sublane_padding_waste(per_chip_batch: int) -> float:
+    """Fraction of wasted sublane rows for a per-chip batch size.
+
+    TPU tiles the sublane axis in units of 8, with a free half-tile for
+    a remainder of exactly 4 and sub-tile packing for power-of-two sizes
+    below 8 — the model behind the measured B=10 cliff: 10 pads to 16
+    (60% waste) and ran 24.22 img/s/chip where B=12 (tiles as 8+4, no
+    waste) ran 58.56 and B=8 54.46 (same session,
+    ``BENCH_r05_phases.jsonl``, docs/PERFORMANCE.md). Returns 0.0 for
+    well-tiled sizes.
+    """
+    b = int(per_chip_batch)
+    if b <= 0 or b % 8 in (0, 4) or b in (1, 2, 4):
+        return 0.0
+    padded = (b // 8 + 1) * 8
+    return (padded - b) / b
+
+
+def nearest_good_batch_sizes(per_chip_batch: int) -> tuple[int, int]:
+    """(nearest well-tiled B below-or-equal, nearest above)."""
+    b = int(per_chip_batch)
+    lo = next(x for x in range(max(b, 1), 0, -1)
+              if sublane_padding_waste(x) == 0.0)
+    hi = next(x for x in range(max(b, 1), b + 9)
+              if sublane_padding_waste(x) == 0.0)
+    return lo, hi
+
+
+def warn_bad_batch_tiling(
+    per_chip_batch: int, threshold: float = 0.2, stacklevel: int = 2
+) -> str | None:
+    """Warn when the per-chip batch pads >``threshold`` on the sublane
+    axis — the measured 2.4x throughput cliff (B=10: 24.22 vs 58.56
+    img/s/chip at B=12, same-session A/B, ``BENCH_r05_phases.jsonl``,
+    docs/PERFORMANCE.md). Called at config build (``load_config``) and
+    by ``bench.py`` so nobody walks into the cliff silently. Returns the
+    warning message, or None when the size tiles fine.
+    """
+    waste = sublane_padding_waste(per_chip_batch)
+    if waste <= threshold:
+        return None
+    lo, hi = nearest_good_batch_sizes(per_chip_batch)
+    msg = (
+        f"per-chip batch {per_chip_batch} pads {waste:.0%} on the TPU "
+        f"sublane axis — a measured 2.4x throughput cliff (B=10 ran "
+        f"24.22 img/s/chip vs 58.56 at B=12, same session, "
+        f"BENCH_r05_phases.jsonl / docs/PERFORMANCE.md). Use "
+        f"{lo} or {hi} instead."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
 
 
 def apply_scaling_rules_to_cfg(cfg: ConfigNode) -> ConfigNode:

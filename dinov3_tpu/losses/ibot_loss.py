@@ -50,9 +50,11 @@ def ibot_patch_loss_masked(
     # exists; x is read in its storage dtype with fp32 accumulation.
     x = student_logits / student_temp
     lse = jax.scipy.special.logsumexp(x.astype(jnp.float32), axis=-1)  # [M]
-    # q * x promotes elementwise inside the fused reduction (no fp32 copy
-    # of x is materialized); the reduction itself always accumulates fp32
-    # even when both operands are bf16 (compute_precision.target_dtype)
+    # Under target_dtype=bf16 BOTH operands are bf16, so the q * x
+    # product is computed in bf16 (no elementwise promotion happens) —
+    # the precision safeguard is solely the fp32 ACCUMULATION of the
+    # reduction (dtype=jnp.float32 below). No fp32 copy of x is ever
+    # materialized either way.
     dot = jnp.sum(teacher_probs * x, axis=-1, dtype=jnp.float32)       # [M]
     per_token = dot - jnp.sum(teacher_probs, axis=-1,
                               dtype=jnp.float32) * lse

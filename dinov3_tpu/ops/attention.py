@@ -133,6 +133,12 @@ def _flash_available() -> bool:
 # regime on dense while leaving flash reachable where its O(N) memory is
 # the point (768px -> 2309 tokens, ViT-7B long-context); the 2309+ side
 # is pending the fixed op-level crossover (scripts/r5b_queue.sh phG2).
+#
+# The SOURCE OF TRUTH for module-built models is the config knob
+# ``kernels.flash_min_seq`` (ssl_default_config.yaml, default 2048) —
+# re-derive the threshold from crossover data by editing config, not this
+# file. This constant is only the fallback for direct dispatch_attention
+# calls that pass flash_min_seq=0.
 FLASH_MIN_SEQ = 2048
 
 

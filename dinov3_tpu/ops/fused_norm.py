@@ -242,7 +242,9 @@ def fused_layernorm(
         def _local(xs, s, b):
             return _ln_nd(xs, s, b, float(eps), interpret)
 
-        return jax.shard_map(
+        from dinov3_tpu.parallel.context import shard_map_compat
+
+        return shard_map_compat(
             _local, mesh=mesh,
             in_specs=(spec, P(None), P(None)),
             out_specs=spec,

@@ -42,7 +42,8 @@ def ring_attention_local(
     are masked); None means no padding anywhere.
     """
     B, C, h, d = q.shape
-    size = jax.lax.axis_size(axis_name)
+    size = (jax.lax.axis_size(axis_name) if hasattr(jax.lax, "axis_size")
+            else jax.lax.psum(1, axis_name))  # psum(1): pre-axis_size jax
     my = jax.lax.axis_index(axis_name)
     scale = d ** -0.5
     qf = q.astype(reduce_dtype) * scale
@@ -132,7 +133,9 @@ def ring_attention(
         n_valid=N if pad else None,
         reduce_dtype=reduce_dtype,
     )
-    out = jax.shard_map(
+    from dinov3_tpu.parallel.context import shard_map_compat
+
+    out = shard_map_compat(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
     if pad:

@@ -1,6 +1,11 @@
+from dinov3_tpu.train.fused_update import (
+    build_fused_update,
+    make_fused_update,
+)
 from dinov3_tpu.train.optimizer import (
     build_optimizer,
     clip_by_per_submodel_norm,
+    per_submodel_norms,
     scheduled_adamw,
 )
 from dinov3_tpu.train.param_groups import build_multiplier_trees
@@ -15,7 +20,9 @@ from dinov3_tpu.train.ssl_meta_arch import SSLMetaArch
 from dinov3_tpu.train.train_step import TrainState, make_train_step
 
 __all__ = [
-    "build_optimizer", "clip_by_per_submodel_norm", "scheduled_adamw",
+    "build_fused_update", "make_fused_update",
+    "build_optimizer", "clip_by_per_submodel_norm", "per_submodel_norms",
+    "scheduled_adamw",
     "build_multiplier_trees", "Schedules", "build_schedules",
     "cosine_schedule", "linear_warmup_cosine_decay",
     "TrainSetup", "build_train_setup", "put_batch",

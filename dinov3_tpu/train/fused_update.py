@@ -1,0 +1,212 @@
+"""Single-pass fused clip + AdamW + teacher-EMA update engine.
+
+The r5 on-chip profile (``PROFILE_r05.json``, docs/PERFORMANCE.md) puts
+28.5% of the ViT-L step in norm/reduce fusions whose largest named
+component is the fp32 weight-shaped elementwise traffic of the optimizer
++ teacher-EMA chain: ~12 ms/step of HBM floor over 304M fp32
+masters+moments. The previous step program streamed that state through
+FOUR sequential tree passes (train/train_step.py):
+
+    1. per-submodel clip        (scale grads, write clipped grads)
+    2. optax.scale_by_adam      (read g, mu, nu; write mu, nu, direction)
+    3. scheduled lr/wd + apply  (read direction, params; write params)
+    4. teacher EMA              (read teacher, new params; write teacher)
+
+each a separate ``tree.map`` whose intermediates XLA does not reliably
+multi-output-fuse across pass boundaries (the profile shows them as
+distinct weight-shaped ``multiply_add``/``multiply_multiply`` programs).
+This engine collapses them into ONE ``tree.map`` whose per-leaf function
+takes ``(grad, param, mu, nu, teacher)`` and returns
+``(new_param, new_mu, new_nu, new_teacher)`` — every fp32 master/moment/
+teacher array is read once and written once per step. The per-submodel
+clip norms are computed as one batched fused reduction up front (grads
+only — the unavoidable second read of grad-shaped data), and all scalar
+schedules (lr / last-layer lr / wd / momentum) stay in-graph exactly as
+in the optax chain.
+
+The math replicates the existing chain operation-for-operation
+(optax.scale_by_adam's moment updates, safe int32 count increment and
+bias correction; scheduled_adamw's per-leaf multipliers;
+optax.apply_updates' cast; ssl_meta_arch.update_ema's fp32 blend), so
+the optax chain in train/optimizer.py remains the reference
+implementation and test oracle — ``tests/test_fused_update.py`` pins
+leaf-for-leaf equivalence over multi-step runs. The engine reuses the
+chain's ``ScheduledAdamWState`` pytree unchanged: checkpoints, sharding
+derivation (train/setup.py eval_shape) and buffer donation are
+identical on both paths. Toggle with ``optim.fused_update`` (default
+on); the bench A/B rung is armed in scripts/r6_queue.sh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dinov3_tpu.train.optimizer import (
+    ScheduledAdamWState,
+    per_submodel_norms,
+)
+from dinov3_tpu.train.param_groups import build_multiplier_trees
+from dinov3_tpu.train.schedules import Schedules
+
+
+def ema_leaf(t: jnp.ndarray, s: jnp.ndarray, momentum) -> jnp.ndarray:
+    """teacher <- m * teacher + (1 - m) * student, fp32 arithmetic, cast
+    back to the teacher's storage dtype.
+
+    Single source of truth for the EMA rule: ``SSLMetaArch.update_ema``
+    (the unfused path) and the fused engine below both apply this exact
+    expression, so the two step programs cannot drift apart.
+    """
+    return (
+        t.astype(jnp.float32) * momentum
+        + s.astype(jnp.float32) * (1.0 - momentum)
+    ).astype(t.dtype)
+
+
+# pytree-leaf sentinel for "no clip scale" (None would be treated as an
+# empty subtree and break the structure match in the fused tree.map)
+_NO_CLIP = object()
+
+
+def _safe_int32_increment(count: jnp.ndarray) -> jnp.ndarray:
+    # optax._src.numerics.safe_int32_increment, replicated so the fused
+    # engine's bias correction is bit-identical to scale_by_adam's
+    max_int32 = jnp.iinfo(jnp.int32).max
+    one = jnp.array(1, jnp.int32)
+    return jnp.where(count < max_int32, count + one, max_int32)
+
+
+def make_fused_update(
+    schedules: Schedules,
+    lr_mult: Any,
+    wd_mult: Any,
+    is_last_layer: Any,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    clip_grad: float | None = None,
+    ema: bool = True,
+) -> Callable:
+    """Build the engine.
+
+    Returns ``update(grads, params, teacher, opt_state, momentum) ->
+    (new_params, new_teacher, new_opt_state, norms)`` where ``norms`` is
+    the per-submodel pre-clip grad-norm dict ({} when clipping is off,
+    matching the unfused path's monitoring contract). ``opt_state`` is
+    the optax chain's ``ScheduledAdamWState`` — init via
+    ``build_optimizer(...).init`` as before.
+
+    ``ema=False`` (distillation: frozen pretrained teacher) passes the
+    teacher through untouched, mirroring ``SSLMetaArch.update_ema``.
+    """
+    lr_arr = jnp.asarray(schedules.lr, jnp.float32)
+    ll_lr_arr = jnp.asarray(schedules.last_layer_lr, jnp.float32)
+    wd_arr = jnp.asarray(schedules.weight_decay, jnp.float32)
+    do_clip = clip_grad is not None and clip_grad > 0
+
+    def update(grads, params, teacher, opt_state, momentum):
+        if not isinstance(opt_state, ScheduledAdamWState):
+            raise TypeError(
+                "fused update engine requires the scheduled_adamw state, "
+                f"got {type(opt_state).__name__}"
+            )
+        i = jnp.minimum(opt_state.count, lr_arr.shape[0] - 1)
+        lr_t, ll_lr_t, wd_t = lr_arr[i], ll_lr_arr[i], wd_arr[i]
+        count_inc = _safe_int32_increment(opt_state.adam.count)
+        # bias corrections are leaf-independent: hoist them out of the map
+        bc1 = 1 - b1 ** count_inc
+        bc2 = 1 - b2 ** count_inc
+
+        norms = {}
+        if do_clip:
+            # one batched reduction over the raw grads, up front; the
+            # scale is then folded into the single per-leaf pass below
+            # instead of materializing a clipped-grads tree
+            norms = per_submodel_norms(grads)
+            scales = {
+                k: jnp.minimum(1.0, clip_grad / jnp.maximum(n, 1e-12))
+                for k, n in norms.items()
+            }
+            scale_tree = {
+                k: jax.tree.map(lambda _, s=scales[k]: s, sub)
+                for k, sub in grads.items()
+            }
+        else:
+            scale_tree = jax.tree.map(lambda _: _NO_CLIP, grads)
+
+        def leaf(g, p, mu, nu, t, lm, wm, is_ll, scale):
+            if scale is not _NO_CLIP:
+                g = (g * scale).astype(g.dtype)
+            # scale_by_adam's moment updates + bias correction, verbatim
+            mu_n = (1 - b1) * g + b1 * mu
+            nu_n = (1 - b2) * (g ** 2) + b2 * nu
+            mu_hat = mu_n / bc1.astype(mu_n.dtype)
+            nu_hat = nu_n / bc2.astype(nu_n.dtype)
+            direction = mu_hat / (jnp.sqrt(nu_hat) + eps)
+            # scheduled_adamw's per-leaf rule, verbatim
+            lr = jnp.where(is_ll, ll_lr_t, lr_t)
+            d = direction + wd_t * wm * p.astype(direction.dtype)
+            upd = -lr * lm * d
+            # optax.apply_updates' cast, verbatim
+            p_n = jnp.asarray(p + upd).astype(p.dtype)
+            if ema:
+                return p_n, mu_n, nu_n, ema_leaf(t, p_n, momentum)
+            return p_n, mu_n, nu_n
+
+        n_out = 4 if ema else 3
+        teacher_arg = teacher if ema else jax.tree.map(lambda _: 0.0, grads)
+        fused = jax.tree.map(
+            leaf, grads, params, opt_state.adam.mu, opt_state.adam.nu,
+            teacher_arg, lr_mult, wd_mult, is_last_layer, scale_tree,
+        )
+        outs = jax.tree.transpose(
+            jax.tree.structure(grads),
+            jax.tree.structure(tuple(range(n_out))),
+            fused,
+        )
+        if ema:
+            new_params, new_mu, new_nu, new_teacher = outs
+        else:
+            new_params, new_mu, new_nu = outs
+            new_teacher = teacher
+        new_opt_state = ScheduledAdamWState(
+            count=opt_state.count + 1,
+            adam=optax.ScaleByAdamState(
+                count=count_inc, mu=new_mu, nu=new_nu
+            ),
+        )
+        return new_params, new_teacher, new_opt_state, norms
+
+    return update
+
+
+def build_fused_update(
+    cfg, params: Any, schedules: Schedules, ema: bool = True
+) -> Callable:
+    """Wire config -> multiplier trees -> fused engine.
+
+    Mirrors ``build_optimizer`` (same multiplier trees, same betas, same
+    clip) so the engine and the optax oracle are built from identical
+    inputs. ``params``: the *student* parameter pytree (unboxed or
+    abstract), used only for path structure.
+    """
+    lr_mult, wd_mult, is_last = build_multiplier_trees(
+        params,
+        layerwise_decay=cfg.optim.layerwise_decay,
+        patch_embed_lr_mult=cfg.optim.patch_embed_lr_mult,
+        dino_head_wd_multiplier=cfg.optim.dino_head_wd_multiplier,
+    )
+    if cfg.optim.optimizer != "adamw":
+        raise ValueError(
+            f"fused update engine supports adamw only, got "
+            f"{cfg.optim.optimizer!r}; set optim.fused_update=false"
+        )
+    return make_fused_update(
+        schedules, lr_mult, wd_mult, is_last,
+        b1=cfg.optim.adamw_beta1, b2=cfg.optim.adamw_beta2,
+        clip_grad=cfg.optim.clip_grad, ema=ema,
+    )

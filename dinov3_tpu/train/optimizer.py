@@ -91,17 +91,27 @@ def build_optimizer(
     )
 
 
+def per_submodel_norms(grads: Any) -> dict:
+    """Global grad norm per top-level submodule (backbone / dino_head /
+    ibot_head): one batched fused reduction over the raw grads. Shared by
+    the unfused clip below and the fused update engine
+    (train/fused_update.py), so both step programs compute the identical
+    norm graph."""
+    return {
+        key: jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                          for l in jax.tree.leaves(sub)))
+        for key, sub in grads.items()
+    }
+
+
 def clip_by_per_submodel_norm(grads: Any, max_norm: float) -> tuple[Any, Any]:
     """Global-norm clip applied independently per top-level submodule
     (backbone / dino_head / ibot_head), as the reference does in-step
     (reference: train/train.py:524-541). Returns (clipped, norms_dict)."""
     clipped = {}
-    norms = {}
+    norms = per_submodel_norms(grads)
     for key, sub in grads.items():
-        leaves = jax.tree.leaves(sub)
-        norm = jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
-                            for l in leaves))
+        norm = norms[key]
         scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
         clipped[key] = jax.tree.map(lambda l: (l * scale).astype(l.dtype), sub)
-        norms[key] = norm
     return clipped, norms

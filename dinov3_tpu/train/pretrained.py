@@ -78,7 +78,9 @@ def _restore_branch(path: str, branch: str, target, target_shardings,
                 f"checkpoint step {step} not found under {path} "
                 f"(available: {sorted(manager.all_steps())})"
             )
-        meta = manager.item_metadata(step)["state"].tree
+        from dinov3_tpu.checkpoint import item_metadata_tree
+
+        meta = item_metadata_tree(manager, step)
         saved_branch = (meta.get("params") or {}).get(branch)
         if saved_branch is None:
             raise KeyError(f"checkpoint at {path} has no params[{branch!r}]")
@@ -88,12 +90,12 @@ def _restore_branch(path: str, branch: str, target, target_shardings,
                 f"no leaf of params[{branch!r}] in {path} matches the "
                 "target shapes"
             )
+        from dinov3_tpu.checkpoint import pytree_restore_args
+
         restored = manager.restore(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.PyTreeRestore(
-                    {"params": {branch: request}}, partial_restore=True
-                )
+                state=pytree_restore_args({"params": {branch: request}})
             ),
         )
     loaded = _merge_restored(target, restored["state"]["params"][branch])

@@ -47,6 +47,7 @@ class TrainSetup:
     state_shardings: TrainState
     step_fn: Callable  # step_fn(state, batch, scalars, rng) -> (state, metrics)
     batch_shardings: dict
+    fused_update: Callable | None = None  # single-pass engine, None = optax chain
 
     def scalars(self, iteration: int) -> dict:
         s = self.schedules.at(iteration)
@@ -80,6 +81,18 @@ def build_train_setup(
         lambda r: meta.init_params(r, example_batch), rng
     )
     optimizer = build_optimizer(cfg, abstract_params["student"], schedules)
+    # default path: the single-pass fused clip+AdamW+EMA engine (state
+    # pytree identical to the optax chain's, so init/sharding/checkpoints
+    # below are path-independent); optim.fused_update=false selects the
+    # optax oracle chain
+    fused = None
+    if cfg.optim.get("fused_update", True):
+        from dinov3_tpu.train.fused_update import build_fused_update
+
+        fused = build_fused_update(
+            cfg, abstract_params["student"], schedules,
+            ema=not meta.distillation,
+        )
 
     def boxed_init(r):
         params = meta.init_params(r, example_batch, unbox=False)
@@ -112,6 +125,7 @@ def build_train_setup(
         meta, optimizer,
         clip_grad=cfg.optim.clip_grad,
         monitor_grad_norm=cfg.train.monitor_gradient_norm,
+        fused_update=fused,
     )
     rep = replicated(mesh)
     scalar_shardings = {"teacher_temp": rep, "momentum": rep}
@@ -124,7 +138,7 @@ def build_train_setup(
     return TrainSetup(
         cfg=cfg, meta=meta, mesh=mesh, schedules=schedules,
         optimizer=optimizer, state=state, state_shardings=state_shardings,
-        step_fn=step_fn, batch_shardings=b_shardings,
+        step_fn=step_fn, batch_shardings=b_shardings, fused_update=fused,
     )
 
 

@@ -582,13 +582,17 @@ class SSLMetaArch:
         it, ``t * momentum`` (bf16 × fp32 scalar array) silently promoted
         a bf16 teacher to fp32 after the first step — changing the step
         signature (a second full XLA compile on step 2).
+
+        The per-leaf rule lives in ``train/fused_update.ema_leaf`` — the
+        fused single-pass engine (default path) applies the same
+        expression inside its one tree.map, so the two step programs
+        cannot drift apart.
         """
         if self.distillation:
             return teacher_params
+        from dinov3_tpu.train.fused_update import ema_leaf
+
         return jax.tree.map(
-            lambda t, s: (
-                t.astype(jnp.float32) * momentum
-                + s.astype(jnp.float32) * (1.0 - momentum)
-            ).astype(t.dtype),
+            lambda t, s: ema_leaf(t, s, momentum),
             teacher_params, student_params,
         )

@@ -7,6 +7,16 @@ jit+shard_map closures — train, EMA, metrics — train/train.py:588-604,
 Fusing the EMA both fixes the reference's frozen-teacher bug by construction
 (SURVEY.md §2.9.1) and lets XLA overlap the EMA's elementwise work with the
 optimizer update.
+
+The update phase itself has two implementations:
+- the optax reference chain (clip -> scale_by_adam -> apply -> EMA, four
+  sequential tree passes) — the test oracle, selected by
+  ``optim.fused_update=false``;
+- the single-pass fused engine (train/fused_update.py, default): one
+  tree.map reading each fp32 master/moment/teacher leaf once and writing
+  it once, attacking the ~12 ms/step weight-shaped HBM floor the r5
+  profile put inside the 28.5% norm/reduce bucket (PROFILE_r05.json,
+  docs/PERFORMANCE.md).
 """
 
 from __future__ import annotations
@@ -33,11 +43,19 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     clip_grad: float | None = 3.0,
     monitor_grad_norm: bool = False,
+    fused_update: Callable | None = None,
 ) -> Callable:
     """Returns step(state, batch, scalars, rng) -> (state, metrics).
 
     scalars: {"teacher_temp": f32, "momentum": f32} traced per-step values
     (indexed from the schedule arrays by the caller or in-graph).
+
+    ``fused_update``: the single-pass clip+AdamW+EMA engine
+    (train/fused_update.build_fused_update). When given, it replaces the
+    clip -> optimizer.update -> apply_updates -> update_ema sequence; it
+    must have been built with the same clip_grad/betas/multipliers as
+    ``optimizer`` (build_train_setup guarantees this — both are wired
+    from the same cfg and schedules).
     """
 
     def step(state: TrainState, batch: dict, scalars: dict, rng: jax.Array):
@@ -64,19 +82,31 @@ def make_train_step(
         )(state.params["student"])
 
         metrics = dict(loss_dict)
-        if clip_grad is not None and clip_grad > 0:
-            grads, norms = clip_by_per_submodel_norm(grads, clip_grad)
+        if fused_update is not None:
+            # single pass over every weight-shaped leaf: clip scales from
+            # one up-front batched reduction, AdamW + EMA folded into one
+            # tree.map (train/fused_update.py)
+            new_student, new_teacher, new_opt_state, norms = fused_update(
+                grads, state.params["student"], state.params["teacher"],
+                state.opt_state, scalars["momentum"],
+            )
             if monitor_grad_norm:
                 for k, v in norms.items():
                     metrics[f"grad_norm/{k}"] = v
+        else:
+            if clip_grad is not None and clip_grad > 0:
+                grads, norms = clip_by_per_submodel_norm(grads, clip_grad)
+                if monitor_grad_norm:
+                    for k, v in norms.items():
+                        metrics[f"grad_norm/{k}"] = v
 
-        updates, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params["student"]
-        )
-        new_student = optax.apply_updates(state.params["student"], updates)
-        new_teacher = meta.update_ema(
-            state.params["teacher"], new_student, scalars["momentum"]
-        )
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params["student"]
+            )
+            new_student = optax.apply_updates(state.params["student"], updates)
+            new_teacher = meta.update_ema(
+                state.params["teacher"], new_student, scalars["momentum"]
+            )
         new_params = dict(state.params)
         new_params["student"] = new_student
         new_params["teacher"] = new_teacher

@@ -108,8 +108,10 @@ gate_phase 2400 phG2_attn_crossover && {
 
 # phT: teacher-target bf16 storage A/B against the committed B=12
 # default (54.46->58.56 was the B sweep; this isolates target_dtype).
-# Pinned: a ladder substitution would invalidate the A/B.
-run_bench phT_target_bf16 2100 pinned \
+# Pinned: a ladder substitution would invalidate the A/B. BENCH_PROBS
+# is pinned bf16 on BOTH arms (the control below already pins it) so
+# the only delta between treatment and control is target_dtype.
+run_bench phT_target_bf16 2100 pinned BENCH_PROBS=bf16 \
     BENCH_OVERRIDES=compute_precision.target_dtype=bf16
 # control re-run in the same session so the A/B shares a host
 run_bench phT_target_fp32_ctl 2100 pinned BENCH_PROBS=bf16

@@ -1,0 +1,113 @@
+#!/bin/bash
+# Round-6 queue: armed for the next healthy tunnel window. Cheapest /
+# highest-evidence first:
+#   phU   fused-update-engine A/B (the 28.5% norm/reduce attack,
+#         train/fused_update.py): default program (fused on) vs
+#         optim.fused_update=false control, same session, both arms
+#         pinned BENCH_PROBS=bf16 at the B=12 default. The committed
+#         host-side accounting (scripts/cost_update_phase.py,
+#         docs/PERFORMANCE.md) shows -34.3% weight-shaped bytes at pass
+#         granularity; this measures what the TPU scheduler actually
+#         does with each form.
+#   phT2  target_dtype=bf16 A/B (re-armed from r5b with BENCH_PROBS
+#         pinned on BOTH arms)
+# Every bench.py record now embeds the fixed calibration rung
+# ("calib"), so these rows are comparable across sessions.
+#
+# Usage: bash scripts/r6_queue.sh  (env: RESULTS, QUEUE_LOG, DEADLINE_HOURS)
+
+set -u
+cd "$(dirname "$0")/.."
+RESULTS="${RESULTS:-/tmp/r6_results.jsonl}"
+LOG="${QUEUE_LOG:-/tmp/r6_queue.log}"
+DEADLINE=$(( $(date +%s) + ${DEADLINE_HOURS:-10} * 3600 ))
+
+note() { echo "[r6 $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+remaining() { echo $(( DEADLINE - $(date +%s) )); }
+
+probe() {
+    timeout 300 python - <<'EOF' >>"$LOG" 2>&1
+import sys
+sys.path.insert(0, ".")
+from dinov3_tpu.utils import respect_jax_platforms_env
+respect_jax_platforms_env()
+import jax
+assert jax.default_backend() != "cpu", "fell back to cpu"
+print("PROBE-OK", jax.device_count())
+EOF
+}
+
+wait_healthy() {
+    while [ "$(remaining)" -gt 0 ]; do
+        if probe; then note "probe healthy"; return 0; fi
+        note "probe unhealthy; sleeping 240s ($(( $(remaining) / 60 )) min to deadline)"
+        sleep 240
+    done
+    note "deadline reached while waiting for a healthy tunnel"
+    return 1
+}
+
+gate_phase() {
+    local backstop="$1" tag="$2"
+    if [ "$(remaining)" -le "$backstop" ]; then
+        note "SKIP $tag: ${backstop}s backstop does not fit in $(remaining)s to deadline"
+        return 1
+    fi
+    wait_healthy || return 1
+    if [ "$(remaining)" -le "$backstop" ]; then
+        note "SKIP $tag: deadline closed in while waiting for a healthy probe"
+        return 1
+    fi
+    return 0
+}
+
+run_bench() {
+    local tag="$1" tmo="$2" kind="$3"; shift 3
+    local backstop budget
+    if [ "$kind" = pinned ]; then
+        budget=$tmo; backstop=$((tmo + 600))
+    else
+        budget=$((3 * tmo)); backstop=$((3 * tmo + 600))
+    fi
+    local try rc out
+    for try in 1 2; do
+        gate_phase "$backstop" "$tag" || return 1
+        note "start $tag try=$try (tmo=${tmo}s budget=${budget}s) env: $*"
+        out=$(env "$@" BENCH_ATTEMPT_TIMEOUT="$tmo" BENCH_TOTAL_BUDGET="$budget" \
+              timeout "$backstop" python bench.py 2>>"$LOG")
+        rc=$?
+        if [ $rc -eq 0 ] && [ -n "$out" ]; then
+            echo "{\"tag\": \"$tag\", \"rc\": 0, \"result\": $out}" >> "$RESULTS"
+            note "done  $tag -> $out"
+            return 0
+        fi
+        if [ -n "$out" ]; then
+            echo "{\"tag\": \"$tag\", \"rc\": $rc, \"result\": $out}" >> "$RESULTS"
+        else
+            echo "{\"tag\": \"$tag\", \"rc\": $rc, \"result\": null}" >> "$RESULTS"
+        fi
+        if [ $rc -eq 3 ] && [ $try -eq 1 ]; then
+            note "INFRA $tag rc=3 (tunnel died mid-run); re-gating on probe for one retry"
+            continue
+        fi
+        note "FAIL  $tag rc=$rc"
+        return $rc
+    done
+}
+
+note "=== r6 queue starting; deadline $(date -d @$DEADLINE +%H:%M:%S) ==="
+
+# phU: fused update engine A/B. Treatment = committed default program
+# (fused on); control strips ONLY the engine. Pinned (no ladder
+# substitution) and same-session so the A/B is clean.
+run_bench phU_fused_on 2100 pinned BENCH_PROBS=bf16
+run_bench phU_fused_off_ctl 2100 pinned BENCH_PROBS=bf16 \
+    BENCH_OVERRIDES=optim.fused_update=false
+
+# phT2: teacher-target bf16 storage A/B, both arms sharing BENCH_PROBS
+run_bench phT2_target_bf16 2100 pinned BENCH_PROBS=bf16 \
+    BENCH_OVERRIDES=compute_precision.target_dtype=bf16
+run_bench phT2_target_fp32_ctl 2100 pinned BENCH_PROBS=bf16
+
+note "=== r6 queue complete; results in $RESULTS ==="

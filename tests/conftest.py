@@ -18,7 +18,12 @@ import jax  # noqa: E402
 # which case the env vars above were captured too late — but the backend is
 # not initialized until first use, so config updates still take effect.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jaxlibs predate jax_num_cpu_devices; the XLA_FLAGS
+    # force_host_platform_device_count set above covers them
+    pass
 jax.config.update("jax_default_matmul_precision", "highest")
 # the suite is compile-dominated; persist compiles across runs (keyed by
 # compiler fingerprint, so a jaxlib upgrade invalidates cleanly). Per-uid

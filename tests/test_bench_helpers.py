@@ -266,3 +266,30 @@ def test_build_step_overrides_shared_contract():
     # 768px: local crops floor at 96*2=192? no — max(96, 768//4)=192
     ov = bench.build_step_overrides("vit_large", 768)
     assert "crops.local_crops_size=192" in ov
+
+
+def test_measure_calibration_fixed_program():
+    """The calibration rung is a fixed program whose record lands in
+    every bench JSON line (and thus every phases-JSONL row a queue
+    harness embeds): assert the program tag is pinned and the measured
+    fields are sane on whatever backend this suite runs."""
+    import jax
+    import jax.numpy as jnp
+
+    calib = bench._measure_calibration(jax, jnp)
+    assert calib["program"] == "matmul1024_bf16_chain_x10"
+    assert calib["ms_per_matmul"] > 0
+    assert calib["tflops"] > 0
+
+
+def test_bench_guardrail_import_path():
+    """bench.py warns through the same guardrail as config build."""
+    import warnings
+
+    from dinov3_tpu.configs.config import warn_bad_batch_tiling
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert warn_bad_batch_tiling(10) is not None   # the measured cliff
+        assert warn_bad_batch_tiling(12) is None       # the bench default
+        assert len(caught) == 1

@@ -139,3 +139,51 @@ def test_dot_overrides_reject_scalar_to_section():
     assert cfg.optim.lr.x == 1
     apply_dot_overrides(cfg, ["+optim=5"])
     assert cfg.optim == 5
+
+
+# ---------------- batch-tiling guardrail ----------------
+
+def test_sublane_padding_waste_model():
+    from dinov3_tpu.configs.config import sublane_padding_waste
+
+    # the measured triple (BENCH_r05_phases.jsonl): B=10 pads to 16,
+    # B=8 and B=12 (8+4) tile cleanly
+    assert sublane_padding_waste(10) == pytest.approx(0.6)
+    assert sublane_padding_waste(8) == 0.0
+    assert sublane_padding_waste(12) == 0.0
+    # small power-of-two batches (the 512px high-res configs) are fine
+    assert sublane_padding_waste(2) == 0.0
+    assert sublane_padding_waste(4) == 0.0
+
+
+def test_batch_tiling_guardrail_fires_on_b10_only():
+    import warnings
+
+    from dinov3_tpu.configs.config import warn_bad_batch_tiling
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        msg = warn_bad_batch_tiling(10)
+        assert msg is not None
+        # cites the measurement and suggests the nearest good sizes
+        assert "24.22" in msg and "58.56" in msg
+        assert "8 or 12" in msg
+        assert len(caught) == 1
+        assert warn_bad_batch_tiling(8) is None
+        assert warn_bad_batch_tiling(12) is None
+        assert len(caught) == 1  # no extra warnings for good sizes
+
+
+def test_batch_tiling_guardrail_at_config_build():
+    import warnings
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        load_config(overrides=["train.batch_size_per_device=10",
+                               "optim.scaling_rule=none"])
+        assert any("sublane" in str(w.message) for w in caught)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        load_config(overrides=["train.batch_size_per_device=12",
+                               "optim.scaling_rule=none"])
+        assert not any("sublane" in str(w.message) for w in caught)

@@ -1,0 +1,334 @@
+"""Fused single-pass clip+AdamW+EMA engine (train/fused_update.py) vs
+the optax oracle chain.
+
+The engine is the default update path (optim.fused_update); the optax
+chain stays in the tree as the reference implementation. These tests pin:
+- leaf-for-leaf multi-step equivalence (params, teacher, mu, nu, counts)
+  with clip active and inactive, last-layer lr freeze, and wd/lr
+  multiplier trees in play. Tolerances: rtol=1e-6, atol=1e-7 — on the
+  cpu backend the two programs are in fact bitwise identical (XLA CSE
+  canonicalizes them to the same HLO; see docs/PERFORMANCE.md), the
+  tolerance budget only covers backend fusion reassociation elsewhere;
+- the full train step producing the same state on both paths;
+- the engine being the default in build_train_setup and compiling under
+  the 8-device dryrun mesh programs (the sharded regression);
+- the bytes-accessed reduction mechanism of scripts/cost_update_phase.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+from dinov3_tpu.train import (
+    build_multiplier_trees,
+    clip_by_per_submodel_norm,
+    make_fused_update,
+    scheduled_adamw,
+)
+from dinov3_tpu.train.fused_update import ema_leaf
+from dinov3_tpu.train.schedules import Schedules
+
+RTOL, ATOL = 1e-6, 1e-7
+
+SMOL = [
+    "student.arch=vit_test", "student.patch_size=4",
+    "student.drop_path_rate=0.0", "student.layerscale=1.0e-5",
+    "crops.global_crops_size=16", "crops.local_crops_size=8",
+    "crops.local_crops_number=2",
+    "dino.head_n_prototypes=32", "dino.head_hidden_dim=24",
+    "dino.head_bottleneck_dim=8",
+    "ibot.head_n_prototypes=32", "ibot.head_hidden_dim=24",
+    "ibot.head_bottleneck_dim=8",
+    "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=4",
+    "optim.warmup_epochs=1", "optim.freeze_last_layer_epochs=1",
+    "compute_precision.compute_dtype=fp32",
+    "optim.scaling_rule=none",
+]
+
+
+def smol_cfg(extra=()):
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, list(SMOL) + list(extra))
+    return cfg
+
+
+def make_sched(n=16):
+    """Non-trivial schedules: varying lr/wd, last-layer frozen 3 steps."""
+    lr = np.linspace(0.1, 0.01, n)
+    ll = lr.copy()
+    ll[:3] = 0.0
+    return Schedules(
+        lr=lr, weight_decay=np.linspace(0.04, 0.4, n),
+        momentum=np.zeros(n), teacher_temp=np.zeros(n),
+        last_layer_lr=ll, total_iters=n,
+    )
+
+
+def fake_params():
+    """Two submodels (separate clip groups), prototypes (last-layer),
+    biases/norms (wd=0), patch embed (lr mult)."""
+    return {
+        "backbone": {
+            "patch_embed": {"kernel": jnp.full((4, 4), 0.5),
+                            "bias": jnp.zeros((4,))},
+            "blocks_0": {"attn": {"qkv_kernel": jnp.full((4, 12), 0.3)}},
+            "norm": {"scale": jnp.ones((4,))},
+        },
+        "dino_head": {
+            "mlp_0": {"kernel": jnp.full((4, 4), 0.2),
+                      "bias": jnp.zeros((4,))},
+            "prototypes": jnp.full((4, 8), 0.1),
+        },
+    }
+
+
+def grads_like(params, key, scale=3.0):
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree.unflatten(treedef, [
+        jax.random.normal(k, l.shape, l.dtype) * scale
+        for k, l in zip(keys, leaves)
+    ])
+
+
+def assert_trees_close(a, b, what):
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_flatten_with_path(a)[0][:64],
+        jax.tree_util.tree_flatten_with_path(b)[0][:64],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(la), np.asarray(lb), rtol=RTOL, atol=ATOL,
+            err_msg=f"{what}: {jax.tree_util.keystr(pa)}",
+        )
+
+
+@pytest.mark.parametrize("clip", [3.0, 0.05, None])
+def test_fused_matches_optax_chain_multistep(clip):
+    """>=10 steps, leaf-for-leaf: params, teacher, mu, nu, both counts.
+
+    clip=0.05 forces the clip scale active every step; clip=None takes
+    the no-clip branch; clip=3.0 mixes (norm-dependent).
+    """
+    sched = make_sched()
+    params = fake_params()
+    lm, wm, ll = build_multiplier_trees(
+        params, layerwise_decay=0.9, patch_embed_lr_mult=0.2,
+        dino_head_wd_multiplier=0.5,
+    )
+    opt = scheduled_adamw(sched, lm, wm, ll)
+    fused = make_fused_update(sched, lm, wm, ll, clip_grad=clip, ema=True)
+    momentum = jnp.asarray(0.95, jnp.float32)
+
+    @jax.jit
+    def ref_step(p, t, s, g):
+        if clip is not None and clip > 0:
+            g, _ = clip_by_per_submodel_norm(g, clip)
+        u, s = opt.update(g, s, p)
+        p = optax.apply_updates(p, u)
+        t = jax.tree.map(lambda tt, ss: ema_leaf(tt, ss, momentum), t, p)
+        return p, t, s
+
+    fused_step = jax.jit(
+        lambda g, p, t, s: fused(g, p, t, s, momentum)[:3])
+
+    teacher = jax.tree.map(jnp.copy, params)
+    p_ref = p_f = params
+    t_ref = t_f = teacher
+    s_ref = s_f = opt.init(params)
+    key = jax.random.key(0)
+    for _ in range(10):
+        key, k = jax.random.split(key)
+        g = grads_like(params, k)
+        p_ref, t_ref, s_ref = ref_step(p_ref, t_ref, s_ref, g)
+        p_f, t_f, s_f = fused_step(g, p_f, t_f, s_f)
+
+    assert_trees_close(p_ref, p_f, "params")
+    assert_trees_close(t_ref, t_f, "teacher")
+    assert_trees_close(s_ref.adam.mu, s_f.adam.mu, "mu")
+    assert_trees_close(s_ref.adam.nu, s_f.adam.nu, "nu")
+    assert int(s_f.count) == 10 and int(s_f.adam.count) == 10
+    # the schedules moved and the updates were non-trivial
+    assert not np.allclose(np.asarray(jax.tree.leaves(p_f)[0]),
+                           np.asarray(jax.tree.leaves(params)[0]))
+    # teacher is a blend, not a copy of the student
+    assert not np.allclose(np.asarray(jax.tree.leaves(t_f)[0]),
+                           np.asarray(jax.tree.leaves(p_f)[0]))
+
+
+def test_last_layer_freeze_respected():
+    """Prototype leaves (last-layer) must not move while last_layer_lr
+    is 0, then move — through the fused engine."""
+    sched = make_sched()
+    params = fake_params()
+    lm, wm, ll = build_multiplier_trees(params)
+    assert jax.tree.leaves(ll).count(True) == 1  # prototypes flagged
+    fused = make_fused_update(sched, lm, wm, ll, clip_grad=None, ema=True)
+    momentum = jnp.asarray(0.9, jnp.float32)
+    t = jax.tree.map(jnp.copy, params)
+    from dinov3_tpu.train import build_optimizer  # noqa: F401 (oracle import)
+    from dinov3_tpu.train.optimizer import scheduled_adamw as _sa
+
+    s = _sa(sched, lm, wm, ll).init(params)
+    p = params
+    key = jax.random.key(1)
+    for i in range(5):
+        key, k = jax.random.split(key)
+        p_new, t, s, _ = fused(grads_like(params, k), p, t, s, momentum)
+        proto_moved = not np.allclose(
+            np.asarray(p_new["dino_head"]["prototypes"]),
+            np.asarray(p["dino_head"]["prototypes"]))
+        assert proto_moved == (i >= 3), f"step {i}"
+        p = p_new
+
+
+def test_fused_distillation_passes_teacher_through():
+    """ema=False (frozen pretrained distillation teacher): the teacher
+    tree is returned untouched — and may have a different structure."""
+    sched = make_sched()
+    params = fake_params()
+    lm, wm, ll = build_multiplier_trees(params)
+    fused = make_fused_update(sched, lm, wm, ll, clip_grad=3.0, ema=False)
+    teacher = {"other_arch": jnp.ones((3,))}
+    from dinov3_tpu.train.optimizer import scheduled_adamw as _sa
+
+    s = _sa(sched, lm, wm, ll).init(params)
+    p, t, s, norms = fused(
+        grads_like(params, jax.random.key(2)), params, teacher, s,
+        jnp.asarray(0.9, jnp.float32))
+    assert t is teacher
+    assert set(norms) == {"backbone", "dino_head"}
+    assert not np.allclose(np.asarray(jax.tree.leaves(p)[0]),
+                           np.asarray(jax.tree.leaves(params)[0]))
+
+
+def test_rejects_foreign_opt_state():
+    sched = make_sched()
+    params = fake_params()
+    lm, wm, ll = build_multiplier_trees(params)
+    fused = make_fused_update(sched, lm, wm, ll)
+    with pytest.raises(TypeError, match="scheduled_adamw"):
+        fused(params, params, params, optax.adam(1e-3).init(params),
+              jnp.float32(0.9))
+
+
+# ---------------- full step + setup integration ----------------
+
+def test_full_train_step_paths_agree():
+    """make_train_step with the fused engine == without, end to end
+    (same forward/backward, same update math)."""
+    from dinov3_tpu.data import make_synthetic_batch
+    from dinov3_tpu.train import build_optimizer, build_schedules
+    from dinov3_tpu.train.fused_update import build_fused_update
+    from dinov3_tpu.train.ssl_meta_arch import SSLMetaArch
+    from dinov3_tpu.train.train_step import TrainState, make_train_step
+
+    cfg = smol_cfg()
+    meta = SSLMetaArch(cfg)
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(cfg, 4, seed=0).items()}
+    params = meta.init_params(jax.random.key(0), batch)
+    sched = build_schedules(cfg)
+    opt = build_optimizer(cfg, params["student"], sched)
+    fused = build_fused_update(cfg, params["student"], sched, ema=True)
+
+    states = {}
+    for name, engine in (("oracle", None), ("fused", fused)):
+        step = jax.jit(make_train_step(
+            meta, opt, clip_grad=cfg.optim.clip_grad, fused_update=engine))
+        state = TrainState(
+            jax.tree.map(jnp.copy, params), opt.init(params["student"]),
+            meta.init_state(), jnp.zeros((), jnp.int32))
+        for i in range(3):
+            scal = sched.at(i)
+            scalars = {
+                "teacher_temp": jnp.asarray(scal["teacher_temp"], jnp.float32),
+                "momentum": jnp.asarray(scal["momentum"], jnp.float32),
+            }
+            state, metrics = step(state, batch, scalars, jax.random.key(7))
+        states[name] = state
+        assert np.isfinite(float(metrics["total_loss"]))
+
+    assert_trees_close(states["oracle"].params, states["fused"].params,
+                       "full-step params")
+    assert_trees_close(states["oracle"].opt_state.adam.nu,
+                       states["fused"].opt_state.adam.nu, "full-step nu")
+
+
+def test_build_train_setup_defaults_to_fused(eight_devices):
+    """optim.fused_update defaults on; =false falls back to the oracle
+    chain. Also the sharded-compile regression: both programs compile
+    and run under dryrun-style 8-device meshes (dp x fsdp x seq with
+    subset drop-path, and dp x fsdp x tensor)."""
+    from dinov3_tpu.data import make_synthetic_batch
+    from dinov3_tpu.train import build_train_setup, put_batch
+
+    for axes, extra in (
+        (["parallel.data=-1", "parallel.fsdp=2", "parallel.seq=2"],
+         ["student.drop_path_rate=0.5", "student.drop_path_mode=subset"]),
+        (["parallel.data=-1", "parallel.fsdp=2", "parallel.tensor=2"],
+         ["optim.fused_update=false"]),
+    ):
+        cfg = smol_cfg(axes + extra)
+        B = 16 if "student.drop_path_rate=0.5" in extra else 8
+        batch = {k: jnp.asarray(v) for k, v in
+                 make_synthetic_batch(cfg, B, seed=0).items()}
+        setup = build_train_setup(cfg, batch, devices=eight_devices)
+        assert (setup.fused_update is not None) == bool(
+            cfg.optim.fused_update)
+        d = put_batch(batch, setup.batch_shardings)
+        state, metrics = setup.step_fn(
+            setup.state, d, setup.scalars(0), jax.random.key(0))
+        assert np.isfinite(float(metrics["total_loss"]))
+        assert int(state.step) == 1
+
+
+def test_sharded_fused_matches_oracle(eight_devices):
+    """Same mesh, same batch: the two update paths produce identical
+    losses and parameters after 2 sharded steps."""
+    from dinov3_tpu.data import make_synthetic_batch
+    from dinov3_tpu.train import build_train_setup, put_batch
+
+    results = {}
+    for flag in ("true", "false"):
+        cfg = smol_cfg(["parallel.data=-1", "parallel.fsdp=2",
+                        f"optim.fused_update={flag}"])
+        batch = {k: jnp.asarray(v) for k, v in
+                 make_synthetic_batch(cfg, 8, seed=0).items()}
+        setup = build_train_setup(cfg, batch, devices=eight_devices)
+        d = put_batch(batch, setup.batch_shardings)
+        state = setup.state
+        for i in range(2):
+            state, m = setup.step_fn(state, d, setup.scalars(i),
+                                     jax.random.key(0))
+        results[flag] = (state, float(m["total_loss"]))
+
+    assert results["true"][1] == pytest.approx(results["false"][1], rel=1e-6)
+    assert_trees_close(results["true"][0].params, results["false"][0].params,
+                       "sharded params")
+
+
+# ---------------- bytes-accessed mechanism ----------------
+
+def test_cost_accounting_reduction():
+    """scripts/cost_update_phase.py's accounting on the test arch: the
+    fused single program accesses fewer bytes than the four-pass chain
+    (the committed ViT-L numbers in docs/PERFORMANCE.md use the same
+    code path; -34.3% there)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "cost_update_phase",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "cost_update_phase.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.measure(smol_cfg())
+    assert rec["bytes_fused"] < rec["bytes_chain_total"]
+    assert rec["reduction_pct"] >= 20.0
+    assert rec["bytes_fused"] >= rec["floor_bytes"]
+    assert set(rec["bytes_chain_passes"]) == {
+        "clip", "adamw", "apply", "ema"}
