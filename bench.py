@@ -50,7 +50,8 @@ itself; 3 = backend unreachable (tunnel down — infra, retry later);
 Env knobs: BENCH_ARCH (vit_large), BENCH_BATCH (per-chip, 12 — the
 round-5 on-chip sweep's peak for the subset drop-path program:
 58.56 img/s/chip at B=12 vs 54.46 at B=8 and a pathological 24.22 at
-B=10, BENCH_r05_phases.jsonl; the old B=8 default was the round-1
+B=10, MEASUREMENTS_r5.md phC rows — the committed BENCH_r05_phases.jsonl
+holds only phA/phB; the old B=8 default was the round-1
 bf16-master peak),
 BENCH_STEPS (10), BENCH_WARMUP (3), BENCH_RES (high-res crop px).
 """

@@ -210,7 +210,7 @@ def sublane_padding_waste(per_chip_batch: int) -> float:
     below 8 — the model behind the measured B=10 cliff: 10 pads to 16
     (60% waste) and ran 24.22 img/s/chip where B=12 (tiles as 8+4, no
     waste) ran 58.56 and B=8 54.46 (same session,
-    ``BENCH_r05_phases.jsonl``, docs/PERFORMANCE.md). Returns 0.0 for
+    ``MEASUREMENTS_r5.md`` phC rows, docs/PERFORMANCE.md). Returns 0.0 for
     well-tiled sizes.
     """
     b = int(per_chip_batch)
@@ -235,8 +235,8 @@ def warn_bad_batch_tiling(
 ) -> str | None:
     """Warn when the per-chip batch pads >``threshold`` on the sublane
     axis — the measured 2.4x throughput cliff (B=10: 24.22 vs 58.56
-    img/s/chip at B=12, same-session A/B, ``BENCH_r05_phases.jsonl``,
-    docs/PERFORMANCE.md). Called at config build (``load_config``) and
+    img/s/chip at B=12, same-session A/B, ``MEASUREMENTS_r5.md`` phC
+    rows, docs/PERFORMANCE.md). Called at config build (``load_config``) and
     by ``bench.py`` so nobody walks into the cliff silently. Returns the
     warning message, or None when the size tiles fine.
     """
@@ -248,7 +248,7 @@ def warn_bad_batch_tiling(
         f"per-chip batch {per_chip_batch} pads {waste:.0%} on the TPU "
         f"sublane axis — a measured 2.4x throughput cliff (B=10 ran "
         f"24.22 img/s/chip vs 58.56 at B=12, same session, "
-        f"BENCH_r05_phases.jsonl / docs/PERFORMANCE.md). Use "
+        f"MEASUREMENTS_r5.md / docs/PERFORMANCE.md). Use "
         f"{lo} or {hi} instead."
     )
     import warnings

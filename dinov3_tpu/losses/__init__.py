@@ -1,5 +1,7 @@
 from dinov3_tpu.losses.dino_loss import (
     dino_loss,
+    dino_pair_ce,
+    pair_ce_to_loss,
     sinkhorn_knopp_teacher,
     softmax_center_teacher,
     update_center,
@@ -7,15 +9,26 @@ from dinov3_tpu.losses.dino_loss import (
 from dinov3_tpu.losses.gram_loss import gram_loss
 from dinov3_tpu.losses.ibot_loss import (
     ibot_patch_loss_dense,
+    ibot_patch_loss_from_parts,
     ibot_patch_loss_masked,
     sinkhorn_knopp_teacher_masked,
 )
 from dinov3_tpu.losses.koleo_loss import koleo_loss
 from dinov3_tpu.losses.sinkhorn import sinkhorn_knopp
+from dinov3_tpu.losses.streaming import (
+    SinkhornFactors,
+    choose_k_tile,
+    ibot_loss_from_spec,
+    pair_ce_from_spec,
+)
 
 __all__ = [
-    "dino_loss", "sinkhorn_knopp_teacher", "softmax_center_teacher",
+    "dino_loss", "dino_pair_ce", "pair_ce_to_loss",
+    "sinkhorn_knopp_teacher", "softmax_center_teacher",
     "update_center", "gram_loss", "ibot_patch_loss_dense",
-    "ibot_patch_loss_masked", "sinkhorn_knopp_teacher_masked", "koleo_loss",
+    "ibot_patch_loss_from_parts", "ibot_patch_loss_masked",
+    "sinkhorn_knopp_teacher_masked", "koleo_loss",
     "sinkhorn_knopp",
+    "SinkhornFactors", "choose_k_tile", "ibot_loss_from_spec",
+    "pair_ce_from_spec",
 ]

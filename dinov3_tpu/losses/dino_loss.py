@@ -51,24 +51,20 @@ def update_center(
     return center * momentum + batch_center * (1.0 - momentum)
 
 
-def dino_loss(
+def dino_pair_ce(
     student_logits: jnp.ndarray,
     teacher_probs: jnp.ndarray,
     student_temp: float = 0.1,
-    ignore_diagonal: bool = False,
 ) -> jnp.ndarray:
-    """Cross-entropy over S x T crop pairs.
+    """[S, B, K] student logits x [T, B, K] teacher probs -> [S, T] CE.
 
-    student_logits: [S, B, K]; teacher_probs: [T, B, K].
-    ``ignore_diagonal`` drops the same-crop pairs (A-A, B-B), normalizing by
-    the remaining pair count (reference:71-89). Static python bool — no
-    ``lax.cond`` needed since it is config-fixed per run.
+    CE via <q, logp> = <q, x> - sum_k(q)*lse(x): the prototype-dim
+    contraction runs on the raw logits (an MXU einsum in their storage
+    dtype) instead of a materialized fp32 log_softmax buffer. This is the
+    materialized ORACLE pair-CE; the streaming engine
+    (losses/streaming.py) computes the same [S, T] matrix without ever
+    materializing ``teacher_probs``.
     """
-    S, B, _ = student_logits.shape
-    T = teacher_probs.shape[0]
-    # CE via <q, logp> = <q, x> - sum_k(q)*lse(x): the prototype-dim
-    # contraction runs on the raw logits (an MXU einsum in their storage
-    # dtype) instead of a materialized fp32 log_softmax buffer.
     x = student_logits / student_temp
     lse = jax.scipy.special.logsumexp(
         x.astype(jnp.float32), axis=-1)                      # [S, B]
@@ -79,9 +75,42 @@ def dino_loss(
     dot = jnp.einsum("sbk,tbk->st", x, teacher_probs,
                      preferred_element_type=jnp.float32)
     corr = jnp.einsum("sb,tb->st", lse, qsum)
-    pair_ce = corr - dot                                     # [S, T]
+    return corr - dot                                        # [S, T]
+
+
+def pair_ce_to_loss(
+    pair_ce: jnp.ndarray,
+    batch_size: int,
+    ignore_diagonal: bool = False,
+) -> jnp.ndarray:
+    """[S, T] pair CE -> scalar loss with the reference normalization.
+
+    ``ignore_diagonal`` drops the same-crop pairs (A-A, B-B), normalizing
+    by the remaining pair count (reference:71-89). Static python bool —
+    no ``lax.cond`` needed since it is config-fixed per run. Shared by
+    the materialized and streaming paths so the normalization cannot
+    drift between them.
+    """
+    S, T = pair_ce.shape
+    B = batch_size
     if ignore_diagonal:
         M = min(S, T)
         pair_ce = pair_ce * (1.0 - jnp.eye(S, T, dtype=pair_ce.dtype))
         return pair_ce.sum() / (B * S * T - B * M)
     return pair_ce.sum() / (B * S * T)
+
+
+def dino_loss(
+    student_logits: jnp.ndarray,
+    teacher_probs: jnp.ndarray,
+    student_temp: float = 0.1,
+    ignore_diagonal: bool = False,
+) -> jnp.ndarray:
+    """Cross-entropy over S x T crop pairs (materialized-targets oracle).
+
+    student_logits: [S, B, K]; teacher_probs: [T, B, K].
+    """
+    B = student_logits.shape[1]
+    pair_ce = dino_pair_ce(student_logits, teacher_probs,
+                           student_temp=student_temp)
+    return pair_ce_to_loss(pair_ce, B, ignore_diagonal=ignore_diagonal)

@@ -30,6 +30,27 @@ def sinkhorn_knopp_teacher_masked(
     )
 
 
+def ibot_patch_loss_from_parts(
+    dot: jnp.ndarray,
+    qsum: jnp.ndarray,
+    lse: jnp.ndarray,
+    masks_weight: jnp.ndarray,
+    n_images: int,
+) -> jnp.ndarray:
+    """Per-row CE parts -> scalar iBOT loss.
+
+    dot: [M] <q_m, x_m>; qsum: [M] sum_k q_m; lse: [M] logsumexp(x_m);
+    masks_weight: [M] with 1/(masked tokens in that image) for valid
+    entries, 0 for padding; n_images: global number of mask rows.
+    loss = -sum_m w_m * <q_m, log p_m> / n_images == mean over images of
+    the mean CE over that image's masked tokens (PyTorch DINOv3
+    semantics). Shared by the materialized and streaming (losses/
+    streaming.py) paths so the weighting cannot drift between them.
+    """
+    per_token = dot - qsum * lse
+    return -jnp.sum(per_token * masks_weight) / max(n_images, 1)
+
+
 def ibot_patch_loss_masked(
     student_logits: jnp.ndarray,
     teacher_probs: jnp.ndarray,
@@ -37,13 +58,9 @@ def ibot_patch_loss_masked(
     n_images: int,
     student_temp: float = 0.1,
 ) -> jnp.ndarray:
-    """CE on masked tokens.
+    """CE on masked tokens (materialized-targets oracle).
 
-    student_logits/teacher_probs: [M, K] padded buffers; masks_weight: [M]
-    with 1/(masked tokens in that image) for valid entries, 0 for padding;
-    n_images: global number of mask rows (images with iBOT applied).
-    loss = -sum_m w_m * <q_m, log p_m> / n_images  == mean over images of the
-    mean CE over that image's masked tokens (PyTorch DINOv3 semantics).
+    student_logits/teacher_probs: [M, K] padded buffers.
     """
     # CE without materializing log-probs: <q, logp> = <q, x> - sum(q)*lse(x)
     # — the [M, K] fp32 log_softmax buffer (65k-262k prototypes) never
@@ -56,9 +73,9 @@ def ibot_patch_loss_masked(
     # reduction (dtype=jnp.float32 below). No fp32 copy of x is ever
     # materialized either way.
     dot = jnp.sum(teacher_probs * x, axis=-1, dtype=jnp.float32)       # [M]
-    per_token = dot - jnp.sum(teacher_probs, axis=-1,
-                              dtype=jnp.float32) * lse
-    return -jnp.sum(per_token * masks_weight) / max(n_images, 1)
+    qsum = jnp.sum(teacher_probs, axis=-1, dtype=jnp.float32)
+    return ibot_patch_loss_from_parts(dot, qsum, lse, masks_weight,
+                                      n_images)
 
 
 def ibot_patch_loss_dense(

@@ -26,7 +26,8 @@ def sinkhorn_knopp(
     row_weights: jnp.ndarray | None = None,
     reduce_dtype=jnp.float32,
     storage_dtype=None,
-) -> jnp.ndarray:
+    return_factors: bool = False,
+):
     """Sinkhorn-normalized teacher targets.
 
     logits: [B, K] global teacher scores (B = all crops x global batch, or
@@ -39,7 +40,11 @@ def sinkhorn_knopp(
     dominant loss-side tensors (r5 on-chip profile); every logsumexp
     still reduces in ``reduce_dtype`` — the storage read upcasts inside
     the fused reduction, so nothing fp32-sized is materialized.
-    Returns [B, K] assignment probabilities (each valid row sums to 1).
+    Returns [B, K] assignment probabilities (each valid row sums to 1) —
+    or, with ``return_factors=True``, the log-domain
+    ``SinkhornFactors(xs, r, c, log_B, valid)`` with
+    ``q = exp(xs - r - c + log_B)`` left UNmaterialized, for the
+    streaming CE engine (losses/streaming.py) to consume tile-by-tile.
     """
     B, K = logits.shape
     NEG = jnp.asarray(-1e30, reduce_dtype)  # "-inf" that stays NaN-free
@@ -90,6 +95,13 @@ def sinkhorn_knopp(
             # contribute nothing to later column reductions
             dr = jnp.where(valid[:, None], dr, 0.0)
         r = r + dr
+    if return_factors:
+        from dinov3_tpu.losses.streaming import SinkhornFactors
+
+        return SinkhornFactors(
+            xs=xs, r=r, c=c,
+            log_B=jnp.asarray(log_B, reduce_dtype), valid=valid,
+        )
     log_q = xs - r - c  # promotes to reduce_dtype inside the fusion
     q = jnp.exp(log_q + log_B).astype(store)  # each valid row sums to 1
     if valid is not None:

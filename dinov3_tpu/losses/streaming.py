@@ -1,0 +1,329 @@
+"""Streaming prototype-axis target/CE engine.
+
+The r5 on-chip profile (``PROFILE_r05.json``, docs/PERFORMANCE.md) puts
+10.2% of the ViT-L step in fp32 passes over the ``[*, 65536]`` teacher
+targets: the softmax-center/Sinkhorn targets were materialized as full
+``[rows, K]`` probability buffers in HBM that the DINO/iBOT
+cross-entropies then re-read. GSPMD places and shards those buffers but
+cannot delete them — avoiding the materialization is algorithmic, and at
+the K=262144 ViT-7B recipes the fp32 target buffer alone is multi-GB.
+
+This engine computes the CE directly from the teacher *logits* in ONE
+pass over K-tiles (``lax.scan`` + ``dynamic_slice`` on the prototype
+axis). Per tile it accumulates, in fp32:
+
+- the teacher's centered-softmax statistics (online running max /
+  sum-exp, flash-attention style rescaling),
+- the student ``logsumexp`` statistics (same online scheme),
+- the ``<q, x>`` cross-term of the logit-einsum CE, rescaled alongside
+  the teacher max so the normalization divides out exactly at the end.
+
+so the ``[rows, K]`` fp32 target buffer NEVER exists in HBM for the
+softmax-center path. For the Sinkhorn path the iterate ``xs`` (stored in
+``compute_precision.target_dtype``) is unavoidable — the Sinkhorn
+iterations themselves need it — but the *materialized q* is not: the CE
+consumes the log-domain factors ``(xs, r, c)`` tile-by-tile
+(bf16/storage-typed tiles in, fp32 accumulators) and ``q`` is
+reconstructed per tile inside the fusion.
+
+Autodiff: the scan body is wrapped in ``jax.checkpoint`` so the backward
+pass REcomputes each tile's ``q``/weights instead of saving them — the
+saved residuals are the per-iteration carries (``[S,T,B]``-sized
+statistics), not ``[rows, K]`` buffers. Gradients flow only through the
+student logits (teacher logits come from stop_gradient'ed params).
+
+Equivalence with the materialized oracle (``dino_loss`` /
+``ibot_patch_loss_masked`` over ``softmax_center_teacher`` /
+``sinkhorn_knopp`` outputs) is pinned by tests/test_streaming_targets.py
+for both centering modes and both target dtypes; the oracle path stays
+selectable with ``loss.streaming_targets=false``.
+
+Sharding note: the K-tile ``dynamic_slice`` runs under GSPMD like any
+other op — with prototype-sharded heads (tensor-axis "vocab") the slice
+is resolved by the partitioner and correctness holds (pinned by the
+8/16-device dryrun programs); pick ``loss.k_tile`` a multiple of
+``K / tensor_axis`` there so tiles stay shard-aligned.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SinkhornFactors(NamedTuple):
+    """Log-domain factorization of Sinkhorn targets:
+    ``q = exp(xs - r - c + log_B)`` (zero on invalid rows).
+
+    xs: [R, K] globally-normalized logits, storage-typed (target_dtype);
+    r: [R, 1] fp32 row offsets; c: [1, K] fp32 column offsets;
+    log_B: fp32 scalar (log of the effective row count);
+    valid: [R] bool or None (fixed-capacity padding mask).
+    """
+
+    xs: jnp.ndarray
+    r: jnp.ndarray
+    c: jnp.ndarray
+    log_B: jnp.ndarray
+    valid: jnp.ndarray | None
+
+
+def choose_k_tile(K: int, cap: int) -> int:
+    """Largest divisor of K that is <= cap (the flash_block convention:
+    the config value is an upper bound, the actual tile always divides)."""
+    t = max(1, min(int(cap) if cap else K, K))
+    while K % t:
+        t -= 1
+    return t
+
+
+@jax.custom_vjp
+def _pin(x):
+    """``optimization_barrier`` with an autodiff rule (absent in older
+    jax): the cotangent tile is pinned the same way, so neither the
+    forward nor the backward program can hoist a full-K buffer."""
+    return jax.lax.optimization_barrier(x)
+
+
+def _pin_fwd(x):
+    return _pin(x), None
+
+
+def _pin_bwd(_, g):
+    return (jax.lax.optimization_barrier(g),)
+
+
+_pin.defvjp(_pin_fwd, _pin_bwd)
+
+
+def _slice_k(arr, i, tk, axis):
+    """Tile ``arr`` along the prototype axis, pinned inside the loop.
+
+    The optimization barrier blocks XLA's loop-invariant code motion
+    from commuting per-tile converts with the slice
+    (``convert(slice(x))`` -> ``slice(convert(x))`` + hoist), which
+    would re-materialize the full [rows, K] fp32 buffer this engine
+    exists to avoid (observed on XLA:CPU without the barrier: the
+    hoisted f32 logits buffer rode the scan carry).
+    """
+    return _pin(jax.lax.dynamic_slice_in_dim(arr, i * tk, tk, axis=axis))
+
+
+# ---------------- pairwise (DINO CLS: every student crop x every
+# teacher crop) ----------------
+
+
+def _pair_ce_softmax_stream(student_logits, t_logits, center, t_temp,
+                            s_temp, tk):
+    """[S,B,K] student logits x [T,B,K] teacher logits -> [S,T] pair CE,
+    teacher targets = softmax((l - center)/t_temp), never materialized."""
+    S, B, K = student_logits.shape
+    T = t_logits.shape[0]
+    f32 = jnp.float32
+    n = K // tk
+    c_full = center.reshape(-1).astype(f32)  # [K]
+
+    def body(carry, i):
+        m_t, s_t, dot, m_s, s_s = carry
+        yt = (_slice_k(t_logits, i, tk, 2).astype(f32)
+              - _slice_k(c_full, i, tk, 0)) / t_temp            # [T,B,tk]
+        # mirrors the oracle: x is divided in its storage dtype
+        # (dino_loss: x = student_logits / student_temp), then promoted
+        # fp32 inside the reductions
+        xt = _slice_k(student_logits, i, tk, 2) / jnp.asarray(
+            s_temp, student_logits.dtype)                        # [S,B,tk]
+        xt_f = xt.astype(f32)
+        new_m_t = jnp.maximum(m_t, yt.max(-1))
+        alpha = jnp.exp(m_t - new_m_t)                           # [T,B]
+        w = jnp.exp(yt - new_m_t[..., None])                     # [T,B,tk]
+        s_t = s_t * alpha + w.sum(-1)
+        dot = dot * alpha[None] + jnp.einsum(
+            "sbk,tbk->stb", xt_f, w, preferred_element_type=f32)
+        new_m_s = jnp.maximum(m_s, xt_f.max(-1))
+        beta = jnp.exp(m_s - new_m_s)
+        s_s = s_s * beta + jnp.exp(xt_f - new_m_s[..., None]).sum(-1)
+        return (new_m_t, s_t, dot, new_m_s, s_s), None
+
+    init = (
+        jnp.full((T, B), -jnp.inf, f32), jnp.zeros((T, B), f32),
+        jnp.zeros((S, T, B), f32),
+        jnp.full((S, B), -jnp.inf, f32), jnp.zeros((S, B), f32),
+    )
+    (m_t, s_t, dot, m_s, s_s), _ = jax.lax.scan(
+        jax.checkpoint(body), init, jnp.arange(n))
+    lse_s = m_s + jnp.log(s_s)                                   # [S,B]
+    # softmax targets sum to exactly 1 per row by construction
+    return lse_s.sum(-1)[:, None] - (dot / s_t[None]).sum(-1)    # [S,T]
+
+
+def _pair_ce_sinkhorn_stream(student_logits, factors: SinkhornFactors,
+                             s_temp, tk):
+    """[S,B,K] student logits x Sinkhorn factor tiles -> [S,T] pair CE.
+
+    ``q`` tiles are reconstructed as ``exp(xs - r - c + log_B)`` from the
+    storage-typed (bf16 under target_dtype=bf16) ``xs`` tiles with fp32
+    accumulation; the materialized ``[T*B, K]`` q buffer never exists.
+    """
+    S, B, K = student_logits.shape
+    R = factors.xs.shape[0]
+    T = R // B
+    f32 = jnp.float32
+    n = K // tk
+    r = factors.r.astype(f32)
+    log_B = factors.log_B.astype(f32)
+
+    def body(carry, i):
+        dot, qsum, m_s, s_s = carry
+        lq = (_slice_k(factors.xs, i, tk, 1).astype(f32) - r
+              - _slice_k(factors.c, i, tk, 1).astype(f32) + log_B)
+        q = jnp.exp(lq).reshape(T, B, tk)
+        xt = _slice_k(student_logits, i, tk, 2) / jnp.asarray(
+            s_temp, student_logits.dtype)
+        xt_f = xt.astype(f32)
+        dot = dot + jnp.einsum(
+            "sbk,tbk->stb", xt_f, q, preferred_element_type=f32)
+        qsum = qsum + q.sum(-1)
+        new_m_s = jnp.maximum(m_s, xt_f.max(-1))
+        beta = jnp.exp(m_s - new_m_s)
+        s_s = s_s * beta + jnp.exp(xt_f - new_m_s[..., None]).sum(-1)
+        return (dot, qsum, new_m_s, s_s), None
+
+    init = (
+        jnp.zeros((S, T, B), f32), jnp.zeros((T, B), f32),
+        jnp.full((S, B), -jnp.inf, f32), jnp.zeros((S, B), f32),
+    )
+    (dot, qsum, m_s, s_s), _ = jax.lax.scan(
+        jax.checkpoint(body), init, jnp.arange(n))
+    lse_s = m_s + jnp.log(s_s)
+    # truncated Sinkhorn rows sum to ~1, not exactly 1: accumulate qsum
+    # like the oracle does
+    corr = jnp.einsum("sb,tb->st", lse_s, qsum)
+    return corr - dot.sum(-1)
+
+
+def pair_ce_from_spec(student_logits, spec, student_temp: float = 0.1,
+                      k_tile: int = 0):
+    """[S,B,K] student logits x a teacher-target spec -> [S,T] pair CE.
+
+    spec kinds (built by SSLMetaArch.get_teacher_output):
+      {"kind": "probs", "probs": [T,B,K]}                 materialized oracle
+      {"kind": "softmax_center", "logits": [T,B,K],
+       "center": [1,K], "temp": scalar}                   streaming
+      {"kind": "sinkhorn", "factors": SinkhornFactors}    streaming
+    """
+    kind = spec["kind"]
+    if kind == "probs":
+        from dinov3_tpu.losses.dino_loss import dino_pair_ce
+
+        return dino_pair_ce(student_logits, spec["probs"],
+                            student_temp=student_temp)
+    K = student_logits.shape[-1]
+    tk = choose_k_tile(K, k_tile)
+    if kind == "softmax_center":
+        return _pair_ce_softmax_stream(
+            student_logits, spec["logits"], spec["center"], spec["temp"],
+            student_temp, tk)
+    if kind == "sinkhorn":
+        return _pair_ce_sinkhorn_stream(
+            student_logits, spec["factors"], student_temp, tk)
+    raise ValueError(f"unknown teacher-target spec kind {kind!r}")
+
+
+# ---------------- row-aligned (iBOT: student masked token i x teacher
+# masked token i) ----------------
+
+
+def _row_ce_softmax_stream(student_logits, t_logits, center, t_temp,
+                           s_temp, tk):
+    """[M,K] x [M,K] -> (dot, qsum, lse) per row, streaming."""
+    M, K = student_logits.shape
+    f32 = jnp.float32
+    n = K // tk
+    c_full = center.reshape(-1).astype(f32)
+
+    def body(carry, i):
+        m_t, s_t, dot, m_s, s_s = carry
+        yt = (_slice_k(t_logits, i, tk, 1).astype(f32)
+              - _slice_k(c_full, i, tk, 0)) / t_temp             # [M,tk]
+        xt = _slice_k(student_logits, i, tk, 1) / jnp.asarray(
+            s_temp, student_logits.dtype)
+        xt_f = xt.astype(f32)
+        new_m_t = jnp.maximum(m_t, yt.max(-1))
+        alpha = jnp.exp(m_t - new_m_t)
+        w = jnp.exp(yt - new_m_t[:, None])
+        s_t = s_t * alpha + w.sum(-1)
+        dot = dot * alpha + (xt_f * w).sum(-1)
+        new_m_s = jnp.maximum(m_s, xt_f.max(-1))
+        beta = jnp.exp(m_s - new_m_s)
+        s_s = s_s * beta + jnp.exp(xt_f - new_m_s[:, None]).sum(-1)
+        return (new_m_t, s_t, dot, new_m_s, s_s), None
+
+    z = jnp.zeros((M,), f32)
+    ninf = jnp.full((M,), -jnp.inf, f32)
+    (m_t, s_t, dot, m_s, s_s), _ = jax.lax.scan(
+        jax.checkpoint(body), (ninf, z, z, ninf, z), jnp.arange(n))
+    return dot / s_t, jnp.ones((M,), f32), m_s + jnp.log(s_s)
+
+
+def _row_ce_sinkhorn_stream(student_logits, factors: SinkhornFactors,
+                            s_temp, tk):
+    M, K = student_logits.shape
+    f32 = jnp.float32
+    n = K // tk
+    r = factors.r.astype(f32)
+    log_B = factors.log_B.astype(f32)
+
+    def body(carry, i):
+        dot, qsum, m_s, s_s = carry
+        lq = (_slice_k(factors.xs, i, tk, 1).astype(f32) - r
+              - _slice_k(factors.c, i, tk, 1).astype(f32) + log_B)
+        q = jnp.exp(lq)                                          # [M,tk]
+        xt = _slice_k(student_logits, i, tk, 1) / jnp.asarray(
+            s_temp, student_logits.dtype)
+        xt_f = xt.astype(f32)
+        dot = dot + (xt_f * q).sum(-1)
+        qsum = qsum + q.sum(-1)
+        new_m_s = jnp.maximum(m_s, xt_f.max(-1))
+        beta = jnp.exp(m_s - new_m_s)
+        s_s = s_s * beta + jnp.exp(xt_f - new_m_s[:, None]).sum(-1)
+        return (dot, qsum, new_m_s, s_s), None
+
+    z = jnp.zeros((M,), f32)
+    (dot, qsum, m_s, s_s), _ = jax.lax.scan(
+        jax.checkpoint(body), (z, z, jnp.full((M,), -jnp.inf, f32), z),
+        jnp.arange(n))
+    return dot, qsum, m_s + jnp.log(s_s)
+
+
+def ibot_loss_from_spec(student_logits, spec, masks_weight, n_images: int,
+                        student_temp: float = 0.1, k_tile: int = 0):
+    """iBOT masked-token CE against a teacher-target spec ([M,K] rows).
+
+    Padding rows carry ``masks_weight == 0`` so their (well-defined but
+    meaningless) streaming CE contributes nothing — same contract as the
+    materialized path, where their q rows are zeroed instead.
+    """
+    from dinov3_tpu.losses.ibot_loss import ibot_patch_loss_from_parts
+
+    kind = spec["kind"]
+    if kind == "probs":
+        from dinov3_tpu.losses.ibot_loss import ibot_patch_loss_masked
+
+        return ibot_patch_loss_masked(
+            student_logits, spec["probs"], masks_weight, n_images,
+            student_temp=student_temp)
+    K = student_logits.shape[-1]
+    tk = choose_k_tile(K, k_tile)
+    if kind == "softmax_center":
+        dot, qsum, lse = _row_ce_softmax_stream(
+            student_logits, spec["logits"], spec["center"], spec["temp"],
+            student_temp, tk)
+    elif kind == "sinkhorn":
+        dot, qsum, lse = _row_ce_sinkhorn_stream(
+            student_logits, spec["factors"], student_temp, tk)
+    else:
+        raise ValueError(f"unknown teacher-target spec kind {kind!r}")
+    return ibot_patch_loss_from_parts(dot, qsum, lse, masks_weight,
+                                      n_images)

@@ -128,7 +128,8 @@ def _flash_available() -> bool:
 # the flash kernel's custom_vjp would block those fusions. Measured
 # full-train-step evidence (v5e): dense wins at N=201 (~1.45x, r1) AND at
 # N=1029 — the 512px ViT-L step runs 9.99 img/s dense vs 7.65 flash
-# (BENCH_r05_phases.jsonl phF), so the old 1024 threshold flipped to the
+# (MEASUREMENTS_r5.md phF rows; the committed BENCH_r05_phases.jsonl
+# holds only phA/phB), so the old 1024 threshold flipped to the
 # slower path at its first live decision point. 2048 keeps every measured
 # regime on dense while leaving flash reachable where its O(N) memory is
 # the point (768px -> 2309 tokens, ViT-7B long-context); the 2309+ side

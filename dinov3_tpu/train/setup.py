@@ -129,11 +129,17 @@ def build_train_setup(
     )
     rep = replicated(mesh)
     scalar_shardings = {"teacher_temp": rep, "momentum": rep}
+    from dinov3_tpu.utils import donation_safe_argnums
+
     step_fn = jax.jit(
         raw_step,
         in_shardings=(state_shardings, b_shardings, scalar_shardings, rep),
         out_shardings=(state_shardings, None),
-        donate_argnums=(0,),
+        # donation is dropped on jaxlib<=0.4.36 cpu with the persistent
+        # compile cache on: deserialized executables there lose the
+        # aliasing table and return donated state STALE (see
+        # utils.donation_safe_argnums)
+        donate_argnums=donation_safe_argnums((0,)),
     )
     return TrainSetup(
         cfg=cfg, meta=meta, mesh=mesh, schedules=schedules,

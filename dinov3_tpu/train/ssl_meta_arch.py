@@ -35,9 +35,9 @@ import jax.numpy as jnp
 
 from dinov3_tpu.configs import ConfigNode
 from dinov3_tpu.losses import (
-    dino_loss,
     gram_loss,
     koleo_loss,
+    pair_ce_to_loss,
     sinkhorn_knopp,
     softmax_center_teacher,
     update_center,
@@ -134,6 +134,24 @@ class SSLMetaArch:
             self.teacher_ibot_head = self.ibot_head
         self.n_local_crops = cfg.crops.local_crops_number
         self.centering = cfg.train.centering
+        # Streaming prototype-axis target/CE engine (losses/streaming.py):
+        # the [*, K] teacher-target buffer is never materialized — the CE
+        # consumes K-tiles of the raw logits (softmax-center) or of the
+        # Sinkhorn log-domain factors. "auto"/true = streaming (default);
+        # false = the materialized oracle path (the test reference, and
+        # the bitwise-reference numerics).
+        loss_cfg = cfg.get("loss") or {}
+        st = loss_cfg.get("streaming_targets", "auto")
+        if isinstance(st, str):
+            low = st.lower()
+            if low not in ("auto", "true", "false", "on", "off"):
+                raise ValueError(
+                    f"loss.streaming_targets must be auto/true/false, "
+                    f"got {st!r}")
+            self.streaming_targets = low in ("auto", "true", "on")
+        else:
+            self.streaming_targets = bool(st)
+        self.loss_k_tile = int(loss_cfg.get("k_tile") or 8192)
         self.gram_enabled = bool(cfg.gram.use_loss)
         self.gram_uses_ema_teacher = bool(cfg.gram.ema_teacher)
         # per-iteration loss-weight ramps (host numpy; moved in-graph by the
@@ -285,7 +303,6 @@ class SSLMetaArch:
             {"params": teacher_params["dino_head"]}, cls
         )  # [2B, K]
         masked = self._gather_masked(patches, batch["mask_indices"])
-        M = masked.shape[1]
         masked_logits = self.teacher_ibot_head.apply(
             {"params": teacher_params["ibot_head"]},
             masked.reshape(-1, self.teacher_embed_dim),
@@ -295,26 +312,59 @@ class SSLMetaArch:
         new_state = dict(state)
         # Teacher-target storage dtype: bf16 halves the HBM footprint of
         # the [*, 65536] target buffers (10.2% of the r5 on-chip step
-        # profile was fp32 passes over them); reductions stay fp32.
+        # profile was fp32 passes over them); reductions stay fp32. Under
+        # the streaming engine the softmax-center path stores NO target
+        # buffer at all, and the Sinkhorn path stores only the log-domain
+        # iterate ``xs`` (target_dtype-typed) — the materialized q never
+        # exists (losses/streaming.py).
         tgt = self.policy.target_dtype
+        stream = self.streaming_targets
         if self.centering == "sinkhorn_knopp":
-            cls_centered = sinkhorn_knopp(
-                cls_logits, teacher_temp, storage_dtype=tgt)
-            masked_centered = sinkhorn_knopp(
+            cls_t = sinkhorn_knopp(
+                cls_logits, teacher_temp, storage_dtype=tgt,
+                return_factors=stream)
+            masked_t = sinkhorn_knopp(
                 masked_logits, teacher_temp,
                 row_weights=valid.astype(self.policy.reduce_dtype),
-                storage_dtype=tgt,
+                storage_dtype=tgt, return_factors=stream,
             )
+            if stream:
+                cls_target = {"kind": "sinkhorn", "factors": cls_t}
+                masked_target = {"kind": "sinkhorn", "factors": masked_t}
+            else:
+                cls_target = {"kind": "probs",
+                              "probs": cls_t.reshape(n_g, B, -1)}
+                masked_target = {"kind": "probs", "probs": masked_t}
         elif self.centering == "softmax_center":
-            cls_centered = softmax_center_teacher(
-                cls_logits, state["dino_center"], teacher_temp,
-                storage_dtype=tgt,
-            )
-            masked_centered = softmax_center_teacher(
-                masked_logits, state["ibot_center"], teacher_temp,
-                storage_dtype=tgt,
-            ) * valid[:, None].astype(tgt or masked_logits.dtype)
+            if stream:
+                K = cls_logits.shape[-1]
+                cls_target = {
+                    "kind": "softmax_center",
+                    "logits": cls_logits.reshape(n_g, B, K),
+                    "center": state["dino_center"], "temp": teacher_temp,
+                }
+                # padding rows (valid == 0) are weighted out by
+                # mask_weights in the loss, matching the materialized
+                # path's explicit q zeroing
+                masked_target = {
+                    "kind": "softmax_center", "logits": masked_logits,
+                    "center": state["ibot_center"], "temp": teacher_temp,
+                }
+            else:
+                cls_centered = softmax_center_teacher(
+                    cls_logits, state["dino_center"], teacher_temp,
+                    storage_dtype=tgt,
+                )
+                masked_centered = softmax_center_teacher(
+                    masked_logits, state["ibot_center"], teacher_temp,
+                    storage_dtype=tgt,
+                ) * valid[:, None].astype(tgt or masked_logits.dtype)
+                cls_target = {"kind": "probs",
+                              "probs": cls_centered.reshape(n_g, B, -1)}
+                masked_target = {"kind": "probs", "probs": masked_centered}
             if update_centers:
+                # bit-identical fp32 EMA accumulation on BOTH paths: the
+                # center update always reads the raw logits buffer
                 new_state["dino_center"] = update_center(
                     state["dino_center"], cls_logits
                 )
@@ -330,8 +380,12 @@ class SSLMetaArch:
         return {
             "cls_pre_head": cls.reshape(n_g, B, -1),
             "patch_pre_head": patches,
-            "cls_centered": cls_centered.reshape(n_g, B, -1),
-            "masked_patch_centered": masked_centered.reshape(2 * B, M, -1),
+            # teacher-target specs (losses/streaming.py pair_ce_from_spec /
+            # ibot_loss_from_spec): "probs" = materialized oracle buffers,
+            # "softmax_center"/"sinkhorn" = streaming (no [*, K] target
+            # buffer). masked rows stay flat [2B*M, K'].
+            "cls_target": cls_target,
+            "masked_target": masked_target,
         }, new_state
 
     def get_student_output(self, student_params, batch, rngs):
@@ -443,16 +497,26 @@ class SSLMetaArch:
             sched = jnp.asarray(self.dino_local_weight_schedule, jnp.float32)
             local_w = sched[jnp.minimum(iteration, sched.shape[0] - 1)]
 
-        dino_local = dino_loss(
-            student_local["cls_after_head"], teacher_global["cls_centered"],
-        )
+        # One pair-CE over ALL student crops (global + local) against the
+        # teacher-target spec: on the streaming path this is a single
+        # K-tiled pass over the teacher logits for BOTH dino losses (the
+        # materialized path reads its q buffer once instead of twice).
+        from dinov3_tpu.losses import pair_ce_from_spec
+
+        g_rows = student_global["cls_after_head"]          # [n_g, B, K]
+        l_rows = student_local["cls_after_head"]           # [n_l, B, K]
+        B = g_rows.shape[1]
+        pair = pair_ce_from_spec(
+            jnp.concatenate([g_rows, l_rows], axis=0),
+            teacher_global["cls_target"], k_tile=self.loss_k_tile,
+        )                                                   # [n_g+n_l, n_g]
+
+        dino_local = pair_ce_to_loss(pair[n_g:], B)
         loss_dict["dino_local_crops_loss"] = dino_local
         total = total + cfg.dino.loss_weight * l_scale * local_w * dino_local
 
-        dino_global = dino_loss(
-            student_global["cls_after_head"], teacher_global["cls_centered"],
-            ignore_diagonal=ignore_diag,
-        )
+        dino_global = pair_ce_to_loss(pair[:n_g], B,
+                                      ignore_diagonal=ignore_diag)
         loss_dict["dino_global_crops_loss"] = dino_global
         total = total + cfg.dino.loss_weight * g_scale * dino_global
 
@@ -468,16 +532,15 @@ class SSLMetaArch:
         total = total + cfg.dino.koleo_loss_weight * n_g * kol
 
         # iBOT on masked tokens
-        from dinov3_tpu.losses import ibot_patch_loss_masked
+        from dinov3_tpu.losses import ibot_loss_from_spec
 
         w = batch["mask_weights"].reshape(-1)
         n_images = batch["masks"].shape[0]
-        ibot = ibot_patch_loss_masked(
+        ibot = ibot_loss_from_spec(
             student_global["masked_patch_after_head"].reshape(
                 -1, cfg.ibot.head_n_prototypes),
-            teacher_global["masked_patch_centered"].reshape(
-                -1, cfg.ibot.head_n_prototypes),
-            w, n_images=n_images,
+            teacher_global["masked_target"],
+            w, n_images=n_images, k_tile=self.loss_k_tile,
         )
         loss_dict["ibot_loss"] = ibot
         total = total + cfg.ibot.loss_weight * ibot

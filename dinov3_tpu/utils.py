@@ -132,6 +132,43 @@ def dump_weights(path: str, params) -> None:
         logger.info("dumped %d arrays to %s", len(flat), path)
 
 
+def donation_safe_argnums(argnums: tuple) -> tuple:
+    """Gate buffer donation on backends where it is provably unsafe.
+
+    jaxlib <= 0.4.36 XLA:CPU drops the input-output aliasing table when an
+    executable is DESERIALIZED from the persistent compilation cache: a
+    cache-hit jitted step whose state is donated returns the donated
+    inputs' stale buffers as outputs — params/teacher/opt-state come back
+    bit-identical to their inputs while non-aliased outputs (metrics, the
+    step counter) are correct. Measured in this repo: the self-check's
+    "student_updates"/"teacher_ema_moves" probes fail on the second
+    same-process build (warm cache) and pass on the first (cold cache);
+    dropping donation restores correctness on the warm path.
+
+    Donation on CPU is a memory hint with no semantic value for the test
+    suite, so on the affected backend (cpu + persistent cache enabled +
+    old jaxlib) this returns ``()``; everywhere else the argnums pass
+    through and the TPU step keeps its in-place buffer reuse. Compile-only
+    users (cost accounting, HLO census) are unaffected — the bug is in
+    execution after deserialization, not in lowering — and may keep
+    explicit donation.
+    """
+    import jaxlib
+
+    try:
+        version = tuple(int(x) for x in jaxlib.__version__.split(".")[:3])
+    except ValueError:
+        return argnums
+    if version >= (0, 5, 0):
+        return argnums
+    if jax.default_backend() != "cpu":
+        return argnums
+    cache_dir = jax.config.jax_compilation_cache_dir
+    if not cache_dir:
+        return argnums
+    return ()
+
+
 def respect_jax_platforms_env() -> None:
     """Make ``JAX_PLATFORMS`` authoritative over sitecustomize config pins.
 

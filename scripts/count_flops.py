@@ -34,7 +34,7 @@ POINTS = {
     "vitl_mask": ("vit_large", 8, 0, "mask", []),
     "vitl_subset": ("vit_large", 8, 0, "subset", []),
     # the r5 default program: B=12, the on-chip sweep peak
-    # (58.56 img/s/chip, BENCH_r05_phases.jsonl)
+    # (58.56 img/s/chip, MEASUREMENTS_r5.md phC row)
     "vitl_subset_b12": ("vit_large", 12, 0, "subset", []),
     # ladder points for the fp32-master BENCH_ARCH rungs (phH); the
     # _mask variants exist because the r1 bf16-master measurements ran

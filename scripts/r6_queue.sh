@@ -11,6 +11,22 @@
 #         does with each form.
 #   phT2  target_dtype=bf16 A/B (re-armed from r5b with BENCH_PROBS
 #         pinned on BOTH arms)
+#   phS   streaming-targets A/B (the 10.2% fp32 target-pass attack,
+#         losses/streaming.py): default program (loss.streaming_targets
+#         auto=on) vs =false materialized-oracle control, same session,
+#         both arms BENCH_PROBS=bf16 at B=12. Host-side accounting
+#         (scripts/cost_target_phase.py, COST_TARGET_r07.json): -69.5%
+#         target-phase bytes at pass granularity for softmax-center,
+#         -15.2% for the default sinkhorn (its iterate passes remain);
+#         this measures what the TPU does with each form. A second pair
+#         pins train.centering=softmax_center where the streaming win
+#         is the large one.
+#   phG2  fixed op-level flash-vs-dense attention crossover
+#         (scripts/bench_attention_crossover.py): the
+#         kernels.flash_min_seq=2048 boundary is measured only at
+#         N=201/1029 full-step points; 2048-2309 and the flash side are
+#         unmeasured (ADVICE r5 low). Seconds-long compiles, banks the
+#         crossover table the threshold cites.
 # Every bench.py record now embeds the fixed calibration rung
 # ("calib"), so these rows are comparable across sessions.
 #
@@ -109,5 +125,36 @@ run_bench phU_fused_off_ctl 2100 pinned BENCH_PROBS=bf16 \
 run_bench phT2_target_bf16 2100 pinned BENCH_PROBS=bf16 \
     BENCH_OVERRIDES=compute_precision.target_dtype=bf16
 run_bench phT2_target_fp32_ctl 2100 pinned BENCH_PROBS=bf16
+
+# phS: streaming prototype-axis target/CE engine A/B. Treatment = the
+# committed default program (loss.streaming_targets auto = on); control
+# strips ONLY the engine. Default sinkhorn centering first, then the
+# softmax-center pair where the host-side accounting says the big win
+# lives (-69.5% target-phase bytes, COST_TARGET_r07.json).
+run_bench phS_stream_on 2100 pinned BENCH_PROBS=bf16
+run_bench phS_stream_off_ctl 2100 pinned BENCH_PROBS=bf16 \
+    BENCH_OVERRIDES=loss.streaming_targets=false
+run_bench phS_sc_stream_on 2100 pinned BENCH_PROBS=bf16 \
+    BENCH_OVERRIDES=train.centering=softmax_center
+run_bench phS_sc_stream_off_ctl 2100 pinned BENCH_PROBS=bf16 \
+    BENCH_OVERRIDES=train.centering=softmax_center,loss.streaming_targets=false
+
+# phG2: the fixed op-level flash-vs-dense crossover (compiles in
+# seconds; measures the kernels.flash_min_seq=2048 boundary including
+# the unmeasured 2048-2309 band and the flash side at N>=2309).
+if gate_phase 2400 phG2_attn_crossover; then
+    note "start phG2_attn_crossover"
+    rm -f /tmp/attn_crossover_r6.jsonl
+    if timeout 2400 python scripts/bench_attention_crossover.py \
+            /tmp/attn_crossover_r6.jsonl >> "$LOG" 2>&1; then
+        note "done  phG2_attn_crossover -> /tmp/attn_crossover_r6.jsonl"
+        while IFS= read -r line; do
+            echo "{\"tag\": \"phG2_attn_crossover\", \"rc\": 0, \"result\": $line}" >> "$RESULTS"
+        done < /tmp/attn_crossover_r6.jsonl
+    else
+        note "FAIL  phG2_attn_crossover rc=$?"
+        echo "{\"tag\": \"phG2_attn_crossover\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
+    fi
+fi
 
 note "=== r6 queue complete; results in $RESULTS ==="

@@ -102,7 +102,7 @@ def test_flash_block_caps_honored():
 def test_auto_dispatch_threshold(monkeypatch):
     """The auto dispatch keeps every *measured* regime on dense XLA.
 
-    Full-step evidence (BENCH_r05_phases.jsonl): dense beats flash at
+    Full-step evidence (MEASUREMENTS_r5.md phF rows): dense beats flash at
     N=201 (224px) and N=1029 (512px, 9.99 vs 7.65 img/s/chip), so auto
     must choose xla there; flash stays reachable at 2309+ (768px) where
     its O(N) memory is the point. Backend/kernel availability are

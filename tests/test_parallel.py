@@ -105,8 +105,13 @@ def test_sharded_matches_single_device(eight_devices):
                            jax.random.key(0))
     _, m1 = setup1.step_fn(setup1.state, d1, setup1.scalars(0),
                            jax.random.key(0))
+    from conftest import legacy_tol
+
+    # jaxlib < 0.5 XLA:CPU: measured 8.4e-4 cross-program skew
+    # (documented in tests/conftest.py legacy_tol)
     np.testing.assert_allclose(
-        float(m8["total_loss"]), float(m1["total_loss"]), rtol=2e-4
+        float(m8["total_loss"]), float(m1["total_loss"]),
+        rtol=legacy_tol(2e-4, 2.5e-3),
     )
 
 

