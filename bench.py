@@ -53,7 +53,12 @@ round-5 on-chip sweep's peak for the subset drop-path program:
 B=10, MEASUREMENTS_r5.md phC rows — the committed BENCH_r05_phases.jsonl
 holds only phA/phB; the old B=8 default was the round-1
 bf16-master peak),
-BENCH_STEPS (10), BENCH_WARMUP (3), BENCH_RES (high-res crop px).
+BENCH_STEPS (10), BENCH_WARMUP (3), BENCH_RES (high-res crop px),
+BENCH_CENSUS=1 (or ``--census``; embed a copy census of the exact
+compiled step — counts/bytes/attribution, utils.hlo_copy_census — in
+the record, so copy regressions surface in the same JSONL artifact as
+throughput; use the env form under supervision, argv does not propagate
+to the measurement child).
 """
 
 from __future__ import annotations
@@ -613,6 +618,22 @@ def main():
                 if "degraded to mask semantics" in str(w.message)]
     _log("compile done")
 
+    census = None
+    if os.environ.get("BENCH_CENSUS") == "1" or "--census" in sys.argv:
+        # copy census of the EXACT program being benched (same compiled
+        # HLO, no recompile), so copy regressions surface in the same
+        # JSONL artifact as the throughput they cost — the attribution
+        # categories are utils.classify_copy's (rng / donation_async /
+        # small / large)
+        from dinov3_tpu.utils import hlo_copy_census
+
+        try:
+            census = hlo_copy_census(compiled.as_text())
+            _log(f"copy census: total={census['hlo_copy_total']} "
+                 f"by_category={census['by_category']}")
+        except Exception as e:  # noqa: BLE001 - census must never kill a run
+            census = {"error": str(e)[:200]}
+
     steps = max(1, steps)
     _phase("warmup")
     # synchronize via a value fetch: block_until_ready can return early
@@ -642,6 +663,8 @@ def main():
         # "Session calibration")
         "calib": calib,
     }
+    if census is not None:
+        rec["copy_census"] = census
     if tiling_warning:
         rec["batch_tiling_warning"] = tiling_warning
     if degraded:

@@ -40,12 +40,21 @@ def _collate_for_cfg(cfg, samples_with_targets, rng: np.random.Generator):
 
 
 class _SeededCollate:
-    """Fresh mask RNG per batch, deterministic given (seed, batch ordinal)."""
+    """Fresh mask RNG per batch, deterministic given (seed, batch
+    ordinal) — counter-based like the device-side rng (rng/plan.py /
+    the step's fold_in(base, iteration)), so the iBOT mask draws feeding
+    all three forward passes realign on resume.
 
-    def __init__(self, cfg, seed: int):
+    ``start_ordinal`` resumes the mask stream with the sampler: before
+    it existed, a restart at iteration k advanced the SAMPLES by k
+    batches but replayed the mask ordinals from 0 — same images, wrong
+    masks vs the uninterrupted run (pinned by the deterministic-resume
+    test in tests/test_rng_plan.py)."""
+
+    def __init__(self, cfg, seed: int, start_ordinal: int = 0):
         self.cfg = cfg
         self.seed = seed
-        self.ordinal = 0
+        self.ordinal = start_ordinal
 
     def __call__(self, samples):
         rng = np.random.default_rng((self.seed, self.ordinal))
@@ -85,7 +94,8 @@ def make_train_pipeline(
     loader = make_data_loader(
         dataset,
         batch_size=local_batch,
-        collate_fn=_SeededCollate(cfg, cfg.train.seed + rank),
+        collate_fn=_SeededCollate(cfg, cfg.train.seed + rank,
+                                  start_ordinal=sampler_advance // local_batch),
         num_workers=cfg.train.get("num_workers", 8),
         shuffle=True,
         seed=cfg.train.seed,

@@ -50,18 +50,19 @@ class _CollectScanBlock(nn.Module):
     scanned over). Only K requested layers are kept — stacking all L
     outputs as scan ys would cost L/K more activation memory at eval time.
     Param path matches ScanBlockAdapter ("blocks"/"block"), so the same
-    trained params serve both applies."""
+    trained params serve both applies. ``dp_plan`` as in
+    ScanBlockAdapter (None on the collect path, which is eval-only)."""
 
     block_kwargs: dict
     collect_idx: tuple  # static, sorted
     remat: str = "none"
 
     @nn.compact
-    def __call__(self, carry, i, rope, deterministic: bool):
+    def __call__(self, carry, i, dp_plan, rope, deterministic: bool):
         x, buf = carry
         x = remat_block_cls(self.remat)(
             **self.block_kwargs, name="block"
-        )(x, rope, deterministic)
+        )(x, rope, deterministic, dp_plan)
         hit = (jnp.asarray(self.collect_idx) == i)[:, None, None, None]
         buf = jnp.where(hit, x[None].astype(buf.dtype), buf)
         return (x, buf), None
@@ -154,7 +155,8 @@ class DinoVisionTransformer(nn.Module):
         parts.append(tokens)
         return jnp.concatenate(parts, axis=1), (h, w)
 
-    def _rope_table(self, h: int, w: int, deterministic: bool):
+    def _rope_table(self, h: int, w: int, deterministic: bool,
+                    aug: dict | None = None):
         if self.pos_embed_type != "rope":
             return None
         periods = rope_periods(
@@ -171,7 +173,7 @@ class DinoVisionTransformer(nn.Module):
                 self.pos_embed_rope_rescale_coords,
             )
         )
-        if not deterministic and augmenting:
+        if not deterministic and augmenting and aug is None:
             rng = self.make_rng("rope")
         sin, cos = rope_sincos(
             h, w, periods,
@@ -181,6 +183,7 @@ class DinoVisionTransformer(nn.Module):
             jitter=self.pos_embed_rope_jitter_coords,
             rescale=self.pos_embed_rope_rescale_coords,
             dtype=canonical_dtype(self.pos_embed_rope_dtype),
+            aug=aug if not deterministic else None,
         )
         # full-length table (identity rows for CLS/storage tokens): the
         # per-block apply becomes one fused fma, no token slice/concat
@@ -207,13 +210,21 @@ class DinoVisionTransformer(nn.Module):
             reduce_dtype=self.reduce_dtype, probs_dtype=self.probs_dtype,
         )
 
-    def _run_blocks(self, x, rope, deterministic, collect: Sequence[int] = ()):
+    def _run_blocks(self, x, rope, deterministic, collect: Sequence[int] = (),
+                    plan: dict | None = None):
         """Run the stack; optionally collect outputs of the listed layers.
 
         Every path composes with every other feature: MoE aux losses ride
         the "losses" collection through scan/vmap (``variable_axes``), and
         the pipeline collects intermediate layers through per-stage
-        buffers (parallel/pipeline.py)."""
+        buffers (parallel/pipeline.py).
+
+        ``plan``: the pass's stacked drop-path plan ({"idx": [L, 2, keep]}
+        or {"keep": [L, 2, B]}, rng/plan.py). The scanned stack consumes
+        it as per-layer scan inputs (``in_axes=0`` — a dynamic-slice of
+        the carried stack, not a folded key); the unrolled stack as
+        static slices. The pipeline path keeps the legacy per-stage rng
+        threading (the meta-arch never hands it a plan)."""
         collected = {}
         if self.pipeline_stages > 1:
             from dinov3_tpu.parallel.pipeline import PipelinedBlocks
@@ -231,32 +242,37 @@ class DinoVisionTransformer(nn.Module):
                 ScanBlockAdapter,
                 variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "drop_path": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast),
+                in_axes=(0 if plan is not None else nn.broadcast,
+                         nn.broadcast, nn.broadcast),
                 length=self.n_blocks,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(block_kwargs=self._block_kwargs(), remat=self.remat, name="blocks")
-            x, _ = scanned(x, rope, deterministic)
+            x, _ = scanned(x, plan, rope, deterministic)
         elif self.scan_layers:
             take = tuple(sorted(collect))
             scanned = nn.scan(
                 _CollectScanBlock,
                 variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "drop_path": True, "dropout": True},
-                in_axes=(0, nn.broadcast, nn.broadcast),
+                in_axes=(0, 0 if plan is not None else nn.broadcast,
+                         nn.broadcast, nn.broadcast),
                 length=self.n_blocks,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(block_kwargs=self._block_kwargs(), collect_idx=take,
               remat=self.remat, name="blocks")
             buf0 = jnp.zeros((len(take),) + x.shape, x.dtype)
             (x, buf), _ = scanned(
-                (x, buf0), jnp.arange(self.n_blocks), rope, deterministic
+                (x, buf0), jnp.arange(self.n_blocks), plan, rope,
+                deterministic
             )
             collected = {i: buf[k] for k, i in enumerate(take)}
         else:
+            from dinov3_tpu.rng.plan import plan_layer_slice
+
             for i in range(self.n_blocks):
                 x = remat_block_cls(self.remat)(
                     **self._block_kwargs(), name=f"blocks_{i}"
-                )(x, rope, deterministic)
+                )(x, rope, deterministic, plan_layer_slice(plan, i))
                 if i in collect:
                     collected[i] = x
         return x, collected
@@ -313,18 +329,25 @@ class DinoVisionTransformer(nn.Module):
         *,
         crop_kind: str = "global",
         deterministic: bool = True,
+        rng_plan: dict | None = None,
     ) -> dict:
         """Forward a batch of same-resolution crops.
 
         x: [B, H, W, C]; masks: optional [B, T] bool (T = H*W/p^2).
+        ``rng_plan``: this pass's precomputed randomness
+        ({"drop_path": ..., "rope": ...}, rng/plan.py) — when given, the
+        forward consumes plan slices and never calls ``make_rng``.
         Returns the reference's feature dict (vision_transformer.py:236-243):
         x_norm_clstoken [B, D], x_storage_tokens [B, S, D],
         x_norm_patchtokens [B, T, D], x_prenorm, masks.
         """
+        rng_plan = rng_plan or {}
         norms = self._make_norms()
         tokens, (h, w) = self._prepare_tokens(x, masks)
-        rope = self._rope_table(h, w, deterministic)
-        out, _ = self._run_blocks(tokens, rope, deterministic)
+        rope = self._rope_table(h, w, deterministic,
+                                aug=rng_plan.get("rope"))
+        out, _ = self._run_blocks(tokens, rope, deterministic,
+                                  plan=rng_plan.get("drop_path"))
         x_cls_reg, x_patch = self._final_norms(
             out, norms, crop_kind=crop_kind, deterministic=deterministic
         )

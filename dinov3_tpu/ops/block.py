@@ -15,33 +15,16 @@ import jax.numpy as jnp
 
 from dinov3_tpu.ops.attention import SelfAttention
 from dinov3_tpu.ops.drop_path import (
+    _SUBSET_FALLBACK_WARNED,  # noqa: F401 - re-export (tests reset it here)
     DropPath,
-    subset_keep_count,
+    mask_residual_planned,
+    resolve_drop_path,
     subset_residual,
+    subset_residual_planned,
 )
 from dinov3_tpu.ops.ffn import make_ffn_layer
 from dinov3_tpu.ops.layer_scale import LayerScale
 from dinov3_tpu.ops.norms import make_norm_layer
-
-_SUBSET_FALLBACK_WARNED: set[str] = set()
-
-
-def _warn_subset_fallback(reason: str) -> None:
-    """One-time (per reason) trace-time warning when a configured
-    ``drop_path_mode=subset`` degrades to mask semantics — silent
-    degradation would let bench records and docs label a mask program
-    as the subset one (ADVICE r3)."""
-    if reason in _SUBSET_FALLBACK_WARNED:
-        return
-    _SUBSET_FALLBACK_WARNED.add(reason)
-    import warnings
-
-    warnings.warn(
-        "drop_path_mode=subset degraded to mask semantics for this "
-        f"program: {reason}. Throughput/FLOP numbers for this run are "
-        "mask-program numbers.",
-        stacklevel=3,
-    )
 
 
 class SelfAttentionBlock(nn.Module):
@@ -77,7 +60,14 @@ class SelfAttentionBlock(nn.Module):
         x: jnp.ndarray,
         rope: tuple[jnp.ndarray, jnp.ndarray] | None = None,
         deterministic: bool = True,
+        dp_plan: dict | None = None,
     ) -> jnp.ndarray:
+        """``dp_plan``: this block's slice of the step-wide RNG plan
+        (rng/plan.py) — {"idx": [2, keep]} (subset kept rows) or
+        {"keep": [2, B]} (mask bits), one entry per residual branch.
+        When given, the block consumes precomputed randomness and calls
+        ``make_rng`` for NOTHING; when None, the legacy per-branch
+        fold_in path runs (the rng.plan=false oracle)."""
         norm_kw = dict(param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype)
         ls = (
             (lambda name: LayerScale(self.layerscale_init, self.param_dtype, name=name))
@@ -112,42 +102,37 @@ class SelfAttentionBlock(nn.Module):
         def mlp_branch(t):
             return ls("ls2")(mlp(norm2(t), deterministic=deterministic))
 
-        if self.drop_path_mode not in ("subset", "mask"):
-            raise ValueError(
-                f"unknown drop_path_mode {self.drop_path_mode!r}; "
-                "expected subset|mask"
-            )
         dropping = self.drop_path_rate > 0.0 and not deterministic
-        use_subset = dropping and self.drop_path_mode == "subset"
-        if use_subset:
+        if dp_plan is not None and dropping:
+            # step-wide RNG plan (rng/plan.py): the subset/mask decision
+            # was made at plan build through the SAME resolve_drop_path,
+            # so the key present in the slice is the decision
+            if "idx" in dp_plan:
+                x = subset_residual_planned(x, attn_branch, dp_plan["idx"][0])
+                x = subset_residual_planned(x, mlp_branch, dp_plan["idx"][1])
+            else:
+                x = mask_residual_planned(
+                    x, attn_branch(x), dp_plan["keep"][0],
+                    self.drop_path_rate)
+                x = mask_residual_planned(
+                    x, mlp_branch(x), dp_plan["keep"][1],
+                    self.drop_path_rate)
+            return x
+        mode = self.drop_path_mode
+        if dropping:
             # stratify by the data-shard count: per-span sampling matches
             # the torch reference's per-rank subsetting and keeps the
             # sampled rows inside each shard's span (subset_residual doc)
             from dinov3_tpu.parallel.context import get_current_mesh
-            from dinov3_tpu.parallel.mesh import data_parallel_size
 
-            mesh = get_current_mesh()
-            B = x.shape[0]
-            G = data_parallel_size(mesh) if mesh is not None else 1
-            groups = G
-            if G > 1 and B % G != 0:
-                # an ungrouped (groups=1) subset gather under a >1-shard
-                # data axis crosses shard spans: GSPMD either fails to
-                # partition the gathered activation or inserts heavy
-                # resharding, with no clear error (ADVICE r3). Mask mode
-                # is per-sample and shards cleanly — use it.
-                _warn_subset_fallback(
-                    f"batch {B} not divisible by data-shard count {G}")
-                use_subset = False
-            elif subset_keep_count(B // groups, self.drop_path_rate) >= B // groups:
-                # batch too small for the rate (e.g. single-row pipeline
-                # microbatches): subsetting would silently disable drop
-                # path — fall back to the per-sample mask for this call
-                _warn_subset_fallback(
-                    f"per-group batch {B // groups} too small for "
-                    f"rate {self.drop_path_rate}")
-                use_subset = False
-        if use_subset:
+            mode, groups = resolve_drop_path(
+                x.shape[0], self.drop_path_rate, self.drop_path_mode,
+                get_current_mesh())
+        elif mode not in ("subset", "mask"):
+            raise ValueError(
+                f"unknown drop_path_mode {mode!r}; expected subset|mask"
+            )
+        if dropping and mode == "subset":
             # reference semantics (block.py:94-117): the branch runs on a
             # random floor(B*(1-rate)) subset — dropped samples skip the
             # compute, not just the residual
@@ -200,16 +185,20 @@ def remat_block_cls(remat: str):
 class ScanBlockAdapter(nn.Module):
     """(carry, ys) scan contract for SelfAttentionBlock, shared by the
     scan-over-blocks model path (models/vision_transformer.py) and the
-    pipeline stages (dinov3_tpu/parallel/pipeline.py)."""
+    pipeline stages (dinov3_tpu/parallel/pipeline.py).
+
+    ``dp_plan`` is this layer's slice of the step-wide RNG plan (scanned
+    with ``in_axes=0`` over the stacked [L, ...] plan arrays) or None on
+    the legacy rng path / pipeline stages."""
 
     block_kwargs: dict
     remat: str = "none"
 
     @nn.compact
-    def __call__(self, x, rope, deterministic: bool):
+    def __call__(self, x, dp_plan, rope, deterministic: bool):
         x = remat_block_cls(self.remat)(
             **self.block_kwargs, name="block"
-        )(x, rope, deterministic)
+        )(x, rope, deterministic, dp_plan)
         return x, None
 
 

@@ -23,10 +23,71 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+_SUBSET_FALLBACK_WARNED: set[str] = set()
+
+
+def warn_subset_fallback(reason: str) -> None:
+    """One-time (per reason) trace-time warning when a configured
+    ``drop_path_mode=subset`` degrades to mask semantics — silent
+    degradation would let bench records and docs label a mask program
+    as the subset one (ADVICE r3)."""
+    if reason in _SUBSET_FALLBACK_WARNED:
+        return
+    _SUBSET_FALLBACK_WARNED.add(reason)
+    import warnings
+
+    warnings.warn(
+        "drop_path_mode=subset degraded to mask semantics for this "
+        f"program: {reason}. Throughput/FLOP numbers for this run are "
+        "mask-program numbers.",
+        stacklevel=3,
+    )
+
 
 def subset_keep_count(batch: int, rate: float) -> int:
     """floor(B * (1 - rate)), at least 1 (reference block.py:88-91)."""
     return max(1, int(batch * (1.0 - rate)))
+
+
+def resolve_drop_path(batch: int, rate: float, mode: str,
+                      mesh=None) -> tuple[str, int]:
+    """Static (mode, groups) decision for one forward pass.
+
+    The SINGLE source of truth for the subset-vs-mask choice, shared by
+    the per-block legacy path (ops/block.py, make_rng per branch) and
+    the step-wide RNG-plan builder (rng/plan.py) — the two programs must
+    make the identical decision or the plan's precomputed indices would
+    not match the block's consumption shape.
+
+    Returns ("subset", groups) or ("mask", 1). ``groups`` stratifies the
+    subset sampling by the data-shard count (see ``subset_residual``);
+    the documented fallbacks (indivisible batch, batch too small for the
+    rate) emit the one-time degradation warning exactly as before.
+    """
+    if mode not in ("subset", "mask"):
+        raise ValueError(
+            f"unknown drop_path_mode {mode!r}; expected subset|mask"
+        )
+    if mode != "subset":
+        return "mask", 1
+    from dinov3_tpu.parallel.mesh import data_parallel_size
+
+    G = data_parallel_size(mesh) if mesh is not None else 1
+    if G > 1 and batch % G != 0:
+        # an ungrouped (groups=1) subset gather under a >1-shard data
+        # axis crosses shard spans: GSPMD either fails to partition the
+        # gathered activation or inserts heavy resharding, with no clear
+        # error (ADVICE r3). Mask mode is per-sample and shards cleanly.
+        warn_subset_fallback(
+            f"batch {batch} not divisible by data-shard count {G}")
+        return "mask", 1
+    if subset_keep_count(batch // G, rate) >= batch // G:
+        # batch too small for the rate (e.g. single-row pipeline
+        # microbatches): subsetting would silently disable drop path
+        warn_subset_fallback(
+            f"per-group batch {batch // G} too small for rate {rate}")
+        return "mask", 1
+    return "subset", G
 
 
 def subset_residual(
@@ -73,6 +134,44 @@ def subset_residual(
     res = branch(xs) * (Bg / keep_g)
     return x.at[idx].add(res.astype(x.dtype), indices_are_sorted=True,
                          unique_indices=True, mode="promise_in_bounds")
+
+
+def subset_residual_planned(
+    x: jnp.ndarray,
+    branch: Callable[[jnp.ndarray], jnp.ndarray],
+    idx: jnp.ndarray,
+) -> jnp.ndarray:
+    """``subset_residual`` consuming a PRECOMPUTED kept-index vector.
+
+    ``idx``: [keep_total] int32, globally sorted, unique, in-bounds —
+    one static slice of the step-wide RNG plan (rng/plan.py
+    ``subset_plan``), which derives all layers' index vectors from ONE
+    fused uniform draw + ONE batched argsort instead of a per-block
+    fold_in/permutation chain. Identical gather/scatter semantics to the
+    in-place sampling path; the branch-scale ``B/keep`` is recovered
+    from the static shapes.
+    """
+    B, keep = x.shape[0], idx.shape[0]
+    xs = jnp.take(x, idx, axis=0, unique_indices=True,
+                  indices_are_sorted=True)
+    res = branch(xs) * (B / keep)
+    return x.at[idx].add(res.astype(x.dtype), indices_are_sorted=True,
+                         unique_indices=True, mode="promise_in_bounds")
+
+
+def mask_residual_planned(
+    x: jnp.ndarray,
+    branch_out: jnp.ndarray,
+    keep_bits: jnp.ndarray,
+    rate: float,
+) -> jnp.ndarray:
+    """``DropPath``'s per-sample mask semantics with PRECOMPUTED
+    Bernoulli keep bits ([B] bool, a static slice of the step plan)."""
+    keep = 1.0 - rate
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    masked = jnp.where(keep_bits.reshape(shape), branch_out / keep,
+                       jnp.zeros_like(branch_out))
+    return x + masked.astype(x.dtype)
 
 
 class DropPath(nn.Module):

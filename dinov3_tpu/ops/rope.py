@@ -97,6 +97,42 @@ def augment_coords(
     return coords
 
 
+def rope_aug_values(
+    u: jnp.ndarray,
+    shift: float | None = None,
+    jitter: float | None = None,
+    rescale: float | None = None,
+) -> dict:
+    """[5] uniforms in [0, 1) -> the concrete augmentation factors.
+
+    Same marginal distributions as ``augment_coords``'s three separate
+    draws (shift ~ U[-s, s] per axis; jitter/rescale ~ log-uniform over
+    [1/j, j]), derived from ONE fused uniform draw so the step-wide RNG
+    plan (rng/plan.py) spends a single threefry op per forward pass on
+    coordinate augmentation instead of a split + three draws.
+    """
+    out = {}
+    if shift is not None:
+        out["shift"] = (2.0 * u[0:2] - 1.0) * shift
+    if jitter is not None:
+        out["jitter"] = jnp.exp((2.0 * u[2:4] - 1.0) * math.log(jitter))
+    if rescale is not None:
+        out["rescale"] = jnp.exp((2.0 * u[4:5] - 1.0) * math.log(rescale))
+    return out
+
+
+def augment_coords_planned(coords: jnp.ndarray, aug: dict) -> jnp.ndarray:
+    """Apply precomputed augmentation factors (``rope_aug_values``)."""
+    d = coords.dtype
+    if "shift" in aug:
+        coords = coords + aug["shift"].astype(d)
+    if "jitter" in aug:
+        coords = coords * aug["jitter"].astype(d)
+    if "rescale" in aug:
+        coords = coords * aug["rescale"].astype(d)
+    return coords
+
+
 def rope_sincos(
     H: int,
     W: int,
@@ -107,10 +143,20 @@ def rope_sincos(
     jitter: float | None = None,
     rescale: float | None = None,
     dtype=jnp.float32,
+    aug: dict | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(sin, cos), each [H*W, 4*len(periods)] == [H*W, head_dim]."""
+    """(sin, cos), each [H*W, 4*len(periods)] == [H*W, head_dim].
+
+    Coordinate augmentation comes from EITHER ``rng`` (legacy in-place
+    draws) OR ``aug`` (precomputed factors from the step-wide RNG plan);
+    passing both is a wiring error.
+    """
+    if rng is not None and aug is not None:
+        raise ValueError("pass either rng or aug (plan), not both")
     coords = patch_coords(H, W, normalize, dtype=jnp.float32)
-    if rng is not None and (shift or jitter or rescale):
+    if aug is not None:
+        coords = augment_coords_planned(coords, aug)
+    elif rng is not None and (shift or jitter or rescale):
         coords = augment_coords(coords, rng, shift, jitter, rescale)
     # [HW, 2, 1] / [P] -> [HW, 2, P] -> [HW, 2P] -> duplicated rotate-half halves
     angles = 2.0 * math.pi * coords[:, :, None] / periods[None, None, :]

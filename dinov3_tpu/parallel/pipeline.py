@@ -85,17 +85,20 @@ class _Stage(nn.Module):
     def __call__(self, x, rope, deterministic: bool, stage_id=None):
         from dinov3_tpu.ops.block import ScanBlockAdapter
 
+        # the pipeline keeps the legacy per-stage rng threading (the
+        # step-wide RNG plan hands stages no plan — ssl_meta_arch falls
+        # back to rng.plan=false under parallel.pipe > 1)
         if not self.collect_idx:
             scanned = nn.scan(
                 ScanBlockAdapter,
                 variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "drop_path": True,
                             "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                 length=self.blocks_per_stage,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(block_kwargs=self.block_kwargs, remat=self.remat, name="blocks")
-            x, _ = scanned(x, rope, deterministic)
+            x, _ = scanned(x, None, rope, deterministic)
             return x
         from dinov3_tpu.models.vision_transformer import _CollectScanBlock
 
@@ -103,7 +106,7 @@ class _Stage(nn.Module):
             _CollectScanBlock,
             variable_axes={"params": 0, "losses": 0},
             split_rngs={"params": True, "drop_path": True, "dropout": True},
-            in_axes=(0, nn.broadcast, nn.broadcast),
+            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast),
             length=self.blocks_per_stage,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(block_kwargs=self.block_kwargs, collect_idx=self.collect_idx,
@@ -111,8 +114,8 @@ class _Stage(nn.Module):
         buf0 = jnp.zeros((len(self.collect_idx),) + x.shape, x.dtype)
         offset = stage_id * self.blocks_per_stage
         (x, buf), _ = scanned(
-            (x, buf0), offset + jnp.arange(self.blocks_per_stage), rope,
-            deterministic,
+            (x, buf0), offset + jnp.arange(self.blocks_per_stage), None,
+            rope, deterministic,
         )
         return x, buf
 

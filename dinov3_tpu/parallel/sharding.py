@@ -65,6 +65,35 @@ def batch_sharding(mesh: Mesh, seq_dim: int | None = None) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def constrain_batch_dim(x: jax.Array, dim: int,
+                        mesh: Mesh | None = None) -> jax.Array:
+    """Pin ONE dimension of an in-graph array onto the data axes.
+
+    Used by the step-wide RNG plan (rng/plan.py) so its stacked
+    randomness arrays are BORN sharded along the batch axis under the
+    same logical rule batch leaves use (("dcn_data", "data", "fsdp") —
+    DEFAULT_LOGICAL_RULES "batch"): the per-layer slices the scanned
+    blocks consume then stay span-local to each data shard, like the
+    activations they index. Dims other than ``dim`` are replicated
+    (they are tiny: layer count, branch pair). No-op without a mesh or
+    when the dim does not divide over the data axes (tiny test shapes).
+    """
+    if mesh is None:
+        from dinov3_tpu.parallel.context import get_current_mesh
+
+        mesh = get_current_mesh()
+    if mesh is None:
+        return x
+    dp = 1
+    for a in ("dcn_data", "data", "fsdp"):
+        dp *= int(mesh.shape.get(a, 1))
+    if dp <= 1 or x.shape[dim] % dp != 0:
+        return x
+    spec = [None] * x.ndim
+    spec[dim] = ("dcn_data", "data", "fsdp")
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
 def batch_specs(mesh: Mesh, batch: dict) -> dict:
     """NamedSharding tree for a collated batch dict (all leaves are
     [global_batch, ...] arrays; scalars replicated)."""

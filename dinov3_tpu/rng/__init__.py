@@ -1,0 +1,14 @@
+from dinov3_tpu.rng.plan import (
+    PassPlanSpec,
+    build_pass_plan,
+    build_step_plan,
+    mask_plan,
+    plan_layer_slice,
+    spec_from_module,
+    subset_plan,
+)
+
+__all__ = [
+    "PassPlanSpec", "build_pass_plan", "build_step_plan", "mask_plan",
+    "plan_layer_slice", "spec_from_module", "subset_plan",
+]

@@ -1,0 +1,200 @@
+"""Step-wide RNG-plan engine: a few large fused draws per step.
+
+The legacy rng path threads tiny folded keys through the whole step:
+``train_step`` folds one key per stream, flax's ``make_rng`` folds a
+path hash per call site, and ``nn.scan``'s ``split_rngs`` derives one
+key per layer — at ViT depth that is hundreds of scalar/u32 threefry
+ops and the copies that shuttle their results between programs. The r5
+on-chip profile priced the copy/small-op bucket at 14.8% of step time,
+and the PR-2 copy census attributed ~98% of the 518 compiled-step
+copy-class HLO ops to exactly this RNG-scalar plumbing
+(COST_TARGET_r07.json; GSPMD, arXiv:2105.04663, makes the general
+point: once the matmuls are at the roofline, per-op dispatch overheads
+are what remains).
+
+This module replaces the per-consumer key chains with ONE counter-based
+derivation per step: ``(seed, iteration)`` -> a handful of LARGE fused
+threefry draws producing a *stacked randomness plan* —
+
+- ``drop_path``: per-(layer, branch) subset kept-index vectors
+  ([L, 2, keep_total] int32, from one uniform draw + one batched
+  argsort) or per-sample Bernoulli keep bits ([L, 2, B] bool, one
+  draw), per student forward pass (global / local crops);
+- ``rope``: the stochastic-RoPE shift/jitter/rescale factors from one
+  [5]-uniform draw per pass;
+- ``dropout``: a stacked per-(layer, branch) key lane (one fused
+  ``jax.random.split``), emitted only when a nonzero dropout rate is
+  configured — the current step program has NO dropout consumer
+  (attention ``proj_drop`` and FFN ``dropout_rate`` are structurally
+  0.0, never wired from config), so the lane stays empty and costs
+  nothing; it exists so a future nonzero-rate wiring draws from the
+  plan instead of reintroducing per-layer fold_in chains.
+
+The iBOT mask draws are host-side by design (data/masking.py packs the
+fixed-capacity buffers the TPU-static meta-arch consumes) and already
+counter-based: the synthetic backend keys its generator by
+``(seed, rank, ordinal)`` and the real pipeline's collate by
+``(seed, rank, batch ordinal)`` (data/pipeline.py ``_SeededCollate`` —
+resume-aligned with the sampler, see its ``start_ordinal``), so the
+masks feeding all three forward passes (teacher / student-global /
+student-local) realign on resume exactly like the device-side plan.
+
+Plan arrays are born sharded along the batch axis via the existing
+logical rules (parallel/sharding.py ``constrain_batch_dim``), and the
+scanned blocks consume them as static slices of the scan inputs — a
+``dynamic-slice`` of a carried array, not a folded scalar key.
+
+The legacy fold_in path stays fully intact as the test oracle behind
+``rng.plan=false`` (draw-for-draw distributional equivalence and
+same-seed determinism are pinned against it in tests/test_rng_plan.py);
+``auto``/true (default) selects the plan. Under pipeline parallelism
+(parallel.pipe > 1) the meta-arch falls back to the legacy path — the
+stage-stacked scan owns its rng threading (parallel/pipeline.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.drop_path import resolve_drop_path, subset_keep_count
+from dinov3_tpu.ops.rope import rope_aug_values
+
+
+@dataclasses.dataclass(frozen=True)
+class PassPlanSpec:
+    """Static description of one student forward pass's randomness.
+
+    Everything here is trace-time static (shapes, rates, modes), so the
+    plan builder and its consumers always agree on the plan's pytree
+    structure.
+    """
+
+    batch: int                      # rows of this pass ([2B] or [n_l*B])
+    n_blocks: int
+    drop_path_rate: float = 0.0
+    drop_path_mode: str = "subset"  # subset | mask (pre-fallback wish)
+    rope_shift: float | None = None
+    rope_jitter: float | None = None
+    rope_rescale: float | None = None
+    dropout_rate: float = 0.0       # structurally 0.0 today (see module doc)
+
+    @property
+    def rope_augmenting(self) -> bool:
+        return any(a is not None for a in (
+            self.rope_shift, self.rope_jitter, self.rope_rescale))
+
+
+def spec_from_module(module, batch: int) -> PassPlanSpec:
+    """Derive a pass spec from a ``DinoVisionTransformer``'s static
+    attributes — the same fields the module itself consults, so spec
+    and consumption cannot drift."""
+    rope_on = module.pos_embed_type == "rope"
+    return PassPlanSpec(
+        batch=batch,
+        n_blocks=module.n_blocks,
+        drop_path_rate=float(module.drop_path_rate),
+        drop_path_mode=module.drop_path_mode,
+        rope_shift=module.pos_embed_rope_shift_coords if rope_on else None,
+        rope_jitter=module.pos_embed_rope_jitter_coords if rope_on else None,
+        rope_rescale=module.pos_embed_rope_rescale_coords if rope_on else None,
+    )
+
+
+def subset_plan(key: jax.Array, n_blocks: int, batch: int, rate: float,
+                groups: int) -> jnp.ndarray:
+    """[L, 2, keep_total] int32 kept-row indices, one fused derivation.
+
+    One uniform draw over [L, 2, G, Bg] + one batched argsort yields a
+    uniformly-random permutation per (layer, branch, group) — the same
+    construction ``jax.random.permutation`` uses internally (sort of
+    random draws), batched across every consumer at once. The first
+    ``keep_g`` entries of each permutation are the kept rows; they are
+    re-sorted and offset per group span, so each [keep_total] slice is
+    globally sorted/unique exactly as ``subset_residual`` samples them
+    in place (uniform over group-span subsets of size keep_g).
+    """
+    Bg = batch // groups
+    keep_g = subset_keep_count(Bg, rate)
+    u = jax.random.uniform(key, (n_blocks, 2, groups, Bg))
+    perm = jnp.argsort(u, axis=-1)
+    kept = jnp.sort(perm[..., :keep_g], axis=-1)
+    offs = (jnp.arange(groups, dtype=kept.dtype) * Bg)[None, None, :, None]
+    return (kept + offs).reshape(
+        n_blocks, 2, groups * keep_g).astype(jnp.int32)
+
+
+def mask_plan(key: jax.Array, n_blocks: int, batch: int,
+              rate: float) -> jnp.ndarray:
+    """[L, 2, B] bool Bernoulli keep bits (``DropPath`` semantics), one
+    fused draw for every (layer, branch)."""
+    return jax.random.bernoulli(key, 1.0 - rate, (n_blocks, 2, batch))
+
+
+def build_pass_plan(key: jax.Array, spec: PassPlanSpec,
+                    mesh=None) -> dict:
+    """Randomness plan for ONE student forward pass.
+
+    Returns a dict with any of:
+      "drop_path": {"idx": [L, 2, keep]} (subset) or
+                   {"keep": [L, 2, B]} (mask) — which one is a STATIC
+                   decision shared with the block via
+                   ``ops/drop_path.resolve_drop_path``;
+      "rope": {"shift"/"jitter"/"rescale": factors};
+      "dropout_keys": [L, 2] stacked key lane (only when
+                      spec.dropout_rate > 0 — never in today's program).
+    """
+    from dinov3_tpu.parallel.sharding import constrain_batch_dim
+
+    k_dp, k_rope, k_drop = jax.random.split(key, 3)
+    plan: dict = {}
+    if spec.drop_path_rate > 0.0:
+        mode, groups = resolve_drop_path(
+            spec.batch, spec.drop_path_rate, spec.drop_path_mode, mesh)
+        if mode == "subset":
+            idx = subset_plan(k_dp, spec.n_blocks, spec.batch,
+                              spec.drop_path_rate, groups)
+            plan["drop_path"] = {"idx": constrain_batch_dim(idx, 2, mesh)}
+        else:
+            keep = mask_plan(k_dp, spec.n_blocks, spec.batch,
+                             spec.drop_path_rate)
+            plan["drop_path"] = {"keep": constrain_batch_dim(keep, 2, mesh)}
+    if spec.rope_augmenting:
+        plan["rope"] = rope_aug_values(
+            jax.random.uniform(k_rope, (5,)),
+            shift=spec.rope_shift, jitter=spec.rope_jitter,
+            rescale=spec.rope_rescale,
+        )
+    if spec.dropout_rate > 0.0:
+        plan["dropout_keys"] = jax.random.split(
+            k_drop, spec.n_blocks * 2).reshape(spec.n_blocks, 2)
+    return plan
+
+
+def build_step_plan(step_key: jax.Array, specs: dict[str, PassPlanSpec],
+                    mesh=None) -> dict:
+    """The full step plan: one pass plan per named student forward pass
+    (``{"global": ..., "local": ...}``).
+
+    ``step_key`` is the counter-derived per-step key
+    (``fold_in(base, iteration)`` in train_step.py) — the plan is a pure
+    function of (seed, iteration, static shapes), so a restart from a
+    checkpoint at iteration k reproduces the draws of an uninterrupted
+    run exactly (tests/test_rng_plan.py pins this for both rng paths).
+    """
+    keys = jax.random.split(step_key, len(specs))
+    return {
+        name: build_pass_plan(k, spec, mesh)
+        for (name, spec), k in zip(sorted(specs.items()), keys)
+    }
+
+
+def plan_layer_slice(plan: dict | None, i) -> dict | None:
+    """Static per-layer slice of a pass plan's stacked drop-path arrays
+    (the unrolled-stack consumer; the scanned stack slices via scan
+    ``in_axes=0`` instead)."""
+    if not plan:
+        return None
+    return jax.tree.map(lambda a: a[i], plan)

@@ -152,6 +152,38 @@ class SSLMetaArch:
         else:
             self.streaming_targets = bool(st)
         self.loss_k_tile = int(loss_cfg.get("k_tile") or 8192)
+        # Step-wide RNG-plan engine (rng/plan.py): one counter-based
+        # derivation per step turns (seed, iteration) into a handful of
+        # large fused draws (drop-path indices/bits, RoPE jitter) that
+        # the forward consumes as static slices — no per-block fold_in
+        # chains. "auto"/true = plan (default); false = the legacy
+        # make_rng path (the test oracle and bitwise-legacy draws).
+        rng_cfg = cfg.get("rng") or {}
+        rp = rng_cfg.get("plan", "auto")
+        if isinstance(rp, str):
+            low = rp.lower()
+            if low not in ("auto", "true", "false", "on", "off"):
+                raise ValueError(
+                    f"rng.plan must be auto/true/false, got {rp!r}")
+            self.rng_plan = low in ("auto", "true", "on")
+        else:
+            self.rng_plan = bool(rp)
+        if self.rng_plan and str(cfg.student.arch).startswith("convnext"):
+            # ConvNeXt backbones consume drop-path through their own
+            # per-stage DropPath modules (models/convnext.py) — plan
+            # wiring is ViT-only; keep the legacy path there
+            self.rng_plan = False
+        pipe = int((cfg.get("parallel") or {}).get("pipe", 1) or 1)
+        if self.rng_plan and pipe > 1:
+            # the stage-stacked pipeline scan owns its rng threading
+            # (parallel/pipeline.py) — fall back loudly, never silently
+            import warnings
+
+            warnings.warn(
+                "rng.plan is not supported under pipeline parallelism "
+                f"(parallel.pipe={pipe}); falling back to the legacy "
+                "fold_in rng path for this run")
+            self.rng_plan = False
         self.gram_enabled = bool(cfg.gram.use_loss)
         self.gram_uses_ema_teacher = bool(cfg.gram.ema_teacher)
         # per-iteration loss-weight ramps (host numpy; moved in-graph by the
@@ -238,14 +270,39 @@ class SSLMetaArch:
 
     # ---------------- forwards ----------------
 
+    def build_rng_plan(self, rng: jax.Array, batch: dict) -> dict:
+        """The step's randomness plan from the counter-derived step key.
+
+        One ``split`` fans the key per student pass; each pass's spec is
+        derived from the student backbone's own static attributes
+        (rng/plan.spec_from_module), so the plan and its consumers
+        cannot disagree on shapes or modes. Built inside the jitted
+        step — the arrays are born sharded along the batch axis
+        (parallel/sharding.constrain_batch_dim).
+        """
+        from dinov3_tpu.parallel.context import get_current_mesh
+        from dinov3_tpu.rng.plan import build_step_plan, spec_from_module
+
+        specs = {
+            "global": spec_from_module(
+                self.student_backbone, batch["global_crops"].shape[0]),
+            "local": spec_from_module(
+                self.student_backbone, batch["local_crops"].shape[0]),
+        }
+        return build_step_plan(rng, specs, get_current_mesh())
+
     def _apply_backbone(self, module, params, x, masks=None, *, crop_kind,
-                        train, rngs=None):
+                        train, rngs=None, rng_plan=None):
+        # rng_plan is a ViT-only kwarg (ConvNeXt backbones keep the
+        # legacy rng path — meta init never enables the plan for them)
+        plan_kw = {} if rng_plan is None else {"rng_plan": rng_plan}
         if train and getattr(module, "ffn_layer", "") == "moe":
             # MoE blocks sow their Switch-style load-balance terms into the
             # "losses" collection; collect them for compute_losses
             out, aux_vars = module.apply(
                 {"params": params}, x, masks, crop_kind=crop_kind,
                 deterministic=not train, rngs=rngs, mutable=["losses"],
+                **plan_kw,
             )
             flat = jax.tree_util.tree_flatten_with_path(
                 aux_vars.get("losses", {})
@@ -278,7 +335,7 @@ class SSLMetaArch:
             return out
         return module.apply(
             {"params": params}, x, masks, crop_kind=crop_kind,
-            deterministic=not train, rngs=rngs,
+            deterministic=not train, rngs=rngs, **plan_kw,
         )
 
     def _gather_masked(self, patch_tokens, mask_indices):
@@ -388,21 +445,33 @@ class SSLMetaArch:
             "masked_target": masked_target,
         }, new_state
 
-    def get_student_output(self, student_params, batch, rngs):
+    def get_student_output(self, student_params, batch, rngs, rng_plan=None):
         g = batch["global_crops"]
         l = batch["local_crops"]
         n_g, n_l = 2, self.n_local_crops
         B = g.shape[0] // n_g
         masks = None if self.cfg.distillation.enabled else batch["masks"]
-        g_out = self._apply_backbone(
-            self.student_backbone, student_params["backbone"], g, masks,
-            crop_kind="global", train=True, rngs=rngs,
-        )
-        l_out = self._apply_backbone(
-            self.student_backbone, student_params["backbone"], l, None,
-            crop_kind="local", train=True,
-            rngs={k: jax.random.fold_in(v, 1) for k, v in rngs.items()},
-        )
+        if rng_plan is not None:
+            # plan path: each pass consumes its own precomputed lane —
+            # no per-pass fold_in, no make_rng anywhere in the forward
+            g_out = self._apply_backbone(
+                self.student_backbone, student_params["backbone"], g, masks,
+                crop_kind="global", train=True, rng_plan=rng_plan["global"],
+            )
+            l_out = self._apply_backbone(
+                self.student_backbone, student_params["backbone"], l, None,
+                crop_kind="local", train=True, rng_plan=rng_plan["local"],
+            )
+        else:
+            g_out = self._apply_backbone(
+                self.student_backbone, student_params["backbone"], g, masks,
+                crop_kind="global", train=True, rngs=rngs,
+            )
+            l_out = self._apply_backbone(
+                self.student_backbone, student_params["backbone"], l, None,
+                crop_kind="local", train=True,
+                rngs={k: jax.random.fold_in(v, 1) for k, v in rngs.items()},
+            )
         g_cls, g_patch = g_out["x_norm_clstoken"], g_out["x_norm_patchtokens"]
         l_cls = l_out["x_norm_clstoken"]
 
@@ -606,18 +675,22 @@ class SSLMetaArch:
         teacher_temp,
         state,
         iteration,
-        rngs,
+        rngs=None,
+        rng_plan=None,
         update_centers=True,
     ):
         """Loss for one batch. ``frozen_params`` = {"teacher": ..,
         ["gram": ..]} under stop_gradient; gradients flow only through
-        ``student_params``."""
+        ``student_params``. Student randomness comes from EITHER ``rngs``
+        (legacy fold_in streams) or ``rng_plan`` (the step-wide plan,
+        ``build_rng_plan``); the teacher/gram passes are deterministic
+        and consume neither."""
         frozen = jax.lax.stop_gradient(frozen_params)
         teacher_global, new_state = self.get_teacher_output(
             frozen["teacher"], batch, teacher_temp, state, update_centers,
         )
         student_global, student_local = self.get_student_output(
-            student_params, batch, rngs
+            student_params, batch, rngs, rng_plan=rng_plan
         )
         gram_feats = None
         if self.gram_enabled:

@@ -17,6 +17,13 @@ The update phase itself has two implementations:
   it once, attacking the ~12 ms/step weight-shaped HBM floor the r5
   profile put inside the 28.5% norm/reduce bucket (PROFILE_r05.json,
   docs/PERFORMANCE.md).
+
+Step randomness likewise has two implementations (the copy/small-op
+sink, 14.8% of the r5 profile): the step-wide RNG plan (rng/plan.py,
+default — a few large fused draws consumed as static slices) and the
+legacy per-consumer fold_in chains behind ``rng.plan=false`` (the test
+oracle). Both derive from ``fold_in(base, iteration)``, so draws at
+iteration k are identical on resume either way.
 """
 
 from __future__ import annotations
@@ -60,12 +67,22 @@ def make_train_step(
 
     def step(state: TrainState, batch: dict, scalars: dict, rng: jax.Array):
         it = state.step
+        # counter-based step key: a pure function of (base key, iteration),
+        # so draws at iteration k are identical whether the run reached k
+        # uninterrupted or restarted from a checkpoint (both rng paths)
         rng = jax.random.fold_in(rng, it)
-        rngs = {
-            "drop_path": jax.random.fold_in(rng, 0),
-            "rope": jax.random.fold_in(rng, 1),
-            "dropout": jax.random.fold_in(rng, 2),
-        }
+        rngs = rng_plan = None
+        if meta.rng_plan:
+            # step-wide RNG plan (rng/plan.py): a handful of large fused
+            # draws replace the per-consumer fold_in chains below — the
+            # copy/small-op dispatch sink the r5 profile priced at 14.8%
+            rng_plan = meta.build_rng_plan(rng, batch)
+        else:
+            rngs = {
+                "drop_path": jax.random.fold_in(rng, 0),
+                "rope": jax.random.fold_in(rng, 1),
+                "dropout": jax.random.fold_in(rng, 2),
+            }
         frozen = {k: v for k, v in state.params.items() if k != "student"}
 
         def loss_fn(student_params):
@@ -75,6 +92,7 @@ def make_train_step(
                 state=state.center_state,
                 iteration=it,
                 rngs=rngs,
+                rng_plan=rng_plan,
             )
 
         (loss, (loss_dict, new_centers)), grads = jax.value_and_grad(

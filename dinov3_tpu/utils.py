@@ -185,3 +185,120 @@ def respect_jax_platforms_env() -> None:
             jax.config.update("jax_platforms", env_plat)
         except RuntimeError:
             pass  # backend already initialized; too late to change
+
+
+# ---------------- compiled-HLO copy census (shared by
+# scripts/cost_target_phase.py, scripts/cost_rng_copies.py and
+# `bench.py --census`) ----------------
+
+_HLO_COMP_HEADER = None  # compiled lazily (re module import kept local)
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+HLO_COPY_OPS = ("copy", "copy-start", "copy-done", "dynamic-update-slice")
+
+
+def hlo_non_fusion_lines(hlo_text: str):
+    """Yield instruction lines outside fused-computation bodies.
+
+    Instructions at the top level of any non-fusion computation (ENTRY,
+    while bodies, conditionals) allocate real buffers; instructions
+    inside a ``%fused_computation...`` body do not — the fusion emits
+    only its root. This is the allocation-relevant line set for the copy
+    census."""
+    import re
+
+    global _HLO_COMP_HEADER
+    if _HLO_COMP_HEADER is None:
+        _HLO_COMP_HEADER = re.compile(r"^(ENTRY\s+)?%?[\w.\-]+\s*\(.*\)\s*->.*\{")
+    in_comp = None
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if _HLO_COMP_HEADER.match(stripped):
+            in_comp = stripped.split("(")[0].strip().lstrip("%")
+            continue
+        if stripped == "}":
+            in_comp = None
+            continue
+        if in_comp is not None and "fused" not in in_comp:
+            yield stripped
+
+
+def _hlo_result_shape(line: str):
+    """(dtype_str, elems, bytes) of an instruction's result, or None.
+
+    Tuple-shaped results (async copy pairs) take their first leaf."""
+    import re
+
+    m = re.search(r"=\s*\(?([a-z]+\d*)\[([\d,]*)\]", line)
+    if not m:
+        return None
+    dtype, dims = m.group(1), m.group(2)
+    if dtype not in _HLO_DTYPE_BYTES:
+        return None
+    elems = 1
+    for d in dims.split(","):
+        if d:
+            elems *= int(d)
+    return dtype, elems, elems * _HLO_DTYPE_BYTES[dtype]
+
+
+def classify_copy(line: str) -> str:
+    """Attribution category for one copy-class HLO instruction.
+
+    - "rng": u32 results of <= 8 elements — threefry key/counter
+      plumbing (keys are u32[2]/u32[4]; fold_in intermediates scalar).
+    - "donation_async": ``copy-start``/``copy-done`` pairs — the async
+      copies the runtime schedules around donated/aliased buffers and
+      cross-memory DMA. (Heuristic by op kind: plain ``copy`` of a
+      donated input exists too but is indistinguishable from a layout
+      copy in HLO text.)
+    - "small": any other result of <= 1024 elements (scalar metrics,
+      index vectors, centers).
+    - "large": activation/weight-shaped copies (> 1024 elements) — a
+      structural regression when a new class of these appears.
+    """
+    if "copy-start" in line or "copy-done" in line:
+        return "donation_async"
+    shp = _hlo_result_shape(line)
+    if shp is None:
+        return "small"
+    dtype, elems, _ = shp
+    if dtype == "u32" and elems <= 8:
+        return "rng"
+    return "small" if elems <= 1024 else "large"
+
+
+def hlo_copy_census(hlo_text: str) -> dict:
+    """Copy-class op counts + bytes + per-category attribution for one
+    compiled HLO module (non-fusion lines only — the buffer-allocating
+    set). Categories: see ``classify_copy``."""
+    import re
+
+    counts = {op: 0 for op in HLO_COPY_OPS}
+    by_cat: dict = {}
+    bytes_total = 0
+    for line in hlo_non_fusion_lines(hlo_text):
+        for op in HLO_COPY_OPS:
+            if re.search(r"=\s*\S+\s+" + re.escape(op) + r"\(", line):
+                counts[op] += 1
+                break
+        else:
+            continue
+        cat = classify_copy(line)
+        shp = _hlo_result_shape(line)
+        nbytes = shp[2] if shp else 0
+        ent = by_cat.setdefault(cat, {"ops": 0, "bytes": 0})
+        ent["ops"] += 1
+        ent["bytes"] += nbytes
+        bytes_total += nbytes
+    return {
+        "hlo_copy_ops": counts,
+        "hlo_copy_total": sum(counts.values()),
+        "hlo_copy_bytes": bytes_total,
+        "by_category": by_cat,
+    }

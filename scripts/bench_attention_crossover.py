@@ -1,117 +1,28 @@
-"""Op-level flash-vs-dense attention crossover on the real chip.
+"""Compat wrapper: the op-level flash-vs-dense crossover harness moved
+to scripts/crossover_attention.py (importable measurement functions +
+the executable ``recommended_flash_min_seq`` threshold definition,
+CPU-collectable test in tests/test_crossover_attention.py). This entry
+point keeps older queue scripts working.
 
-The full-step high-res benches compile for 20-40+ min through the axon
-tunnel helper and have wedged it twice; this measures the SAME dispatch
-decision (``dinov3_tpu/ops/attention.py FLASH_MIN_SEQ``) with tiny
-programs that compile in seconds: fwd+bwd of dense-XLA vs Pallas-flash
-attention at the token counts the recipes actually produce
-(224px->201, 512px->1029, 518px->1054, 768px->2309, plus 4096).
-
-Prints one JSON line per (N, impl) with ms/call, and a final crossover
-summary. Usage: python scripts/bench_attention_crossover.py [out.jsonl]
+Usage: python scripts/bench_attention_crossover.py [out.jsonl]
 """
 
 from __future__ import annotations
 
-import json
+import importlib.util
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+_spec = importlib.util.spec_from_file_location(
+    "crossover_attention", os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "crossover_attention.py")
+)
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
 
-def main():
-    from dinov3_tpu.utils import respect_jax_platforms_env
-
-    respect_jax_platforms_env()
-    import jax
-    import jax.numpy as jnp
-
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
-
-    from dinov3_tpu.ops.attention import xla_attention
-
-    out_path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/attn_crossover.jsonl"
-    out = open(out_path, "a")
-
-    # ViT-L geometry: 16 heads x 64 head_dim; B chosen so B*N is roughly
-    # the 224px global-crop workload (16 seqs x 201 tokens) per call
-    H, D = 16, 64
-    cases = [
-        (16, 201), (4, 1029), (4, 1054), (2, 2309), (1, 4096),
-    ]
-    if os.environ.get("XOVER_MAX_N"):  # CPU smoke: skip the big cases
-        cases = [c for c in cases if c[1] <= int(os.environ["XOVER_MAX_N"])]
-    steps = int(os.environ.get("XOVER_STEPS", "20"))
-    warmup = 3
-    results = {}
-    for B, N in cases:
-        q, k, v = (
-            jax.random.normal(jax.random.key(i), (B, N, H, D), jnp.bfloat16)
-            for i in range(3)
-        )
-        for impl in ("xla", "pallas"):
-            if impl == "pallas":
-                try:
-                    from dinov3_tpu.ops.flash_attention import flash_attention
-                except ImportError:
-                    continue
-
-                def fwd(q, k, v):
-                    return flash_attention(q, k, v)
-            else:
-
-                def fwd(q, k, v):
-                    return xla_attention(q, k, v, probs_dtype=jnp.bfloat16)
-
-            # fwd+bwd like the train step sees it
-            f = jax.jit(jax.grad(
-                lambda q, k, v: jnp.sum(fwd(q, k, v).astype(jnp.float32)),
-                argnums=(0, 1, 2),
-            ))
-
-            # Synchronize via a value fetch, NOT block_until_ready: the
-            # tunneled-TPU transport can return from block_until_ready at
-            # enqueue time (bench.py measure loop has the same note), which
-            # made the r5 first-pass numbers ~70x faster than the chip's
-            # bf16 peak. The fetched scalar forces the whole chain.
-            def sync(g):
-                return float(jnp.sum(g[0].astype(jnp.float32)))
-
-            try:
-                t0 = time.time()
-                sync(f(q, k, v))
-                compile_s = time.time() - t0
-                for _ in range(warmup):
-                    g = f(q, k, v)
-                sync(g)
-                t0 = time.perf_counter()
-                for _ in range(steps):
-                    g = f(q, k, v)
-                sync(g)
-                ms = (time.perf_counter() - t0) / steps * 1e3
-            except Exception as e:  # noqa: BLE001 - record and continue
-                rec = {"B": B, "N": N, "impl": impl, "error": str(e)[:200]}
-                print(json.dumps(rec)); out.write(json.dumps(rec) + "\n")
-                continue
-            rec = {"B": B, "N": N, "impl": impl, "ms": round(ms, 3),
-                   "compile_s": round(compile_s, 1)}
-            results[(B, N, impl)] = ms
-            print(json.dumps(rec), flush=True)
-            out.write(json.dumps(rec) + "\n"); out.flush()
-
-    summary = []
-    for B, N in cases:
-        a, b = results.get((B, N, "xla")), results.get((B, N, "pallas"))
-        if a and b:
-            summary.append({"N": N, "xla_ms": round(a, 3),
-                            "flash_ms": round(b, 3),
-                            "flash_speedup": round(a / b, 3)})
-    line = json.dumps({"crossover": summary})
-    print(line, flush=True)
-    out.write(line + "\n")
-
+main = _mod.main
 
 if __name__ == "__main__":
     main()

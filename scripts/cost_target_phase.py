@@ -183,30 +183,13 @@ def measure_target_phase(cfg, centering: str, target_dtype) -> dict:
 # materialization check) ----------------
 
 
-_COMP_HEADER = re.compile(r"^(ENTRY\s+)?%?[\w.\-]+\s*\(.*\)\s*->.*\{")
-
-
 def non_fusion_lines(hlo_text: str):
-    """Yield instruction lines outside fused-computation bodies.
+    """Instruction lines outside fused-computation bodies — the
+    allocation-relevant set for both the copy census and the [*, K]
+    materialization check (shared impl: utils.hlo_non_fusion_lines)."""
+    from dinov3_tpu.utils import hlo_non_fusion_lines
 
-    Instructions at the top level of any non-fusion computation (ENTRY,
-    while bodies, conditionals) allocate real buffers; instructions
-    inside a ``%fused_computation...`` body do not — the fusion emits
-    only its root. This is the allocation-relevant line set for both the
-    copy census and the [*, K] materialization check.
-    """
-    in_comp = None
-    for line in hlo_text.splitlines():
-        stripped = line.strip()
-        if _COMP_HEADER.match(stripped):
-            name = stripped.split("(")[0].strip().lstrip("%")
-            in_comp = name
-            continue
-        if stripped == "}":
-            in_comp = None
-            continue
-        if in_comp is not None and "fused" not in in_comp:
-            yield stripped
+    return hlo_non_fusion_lines(hlo_text)
 
 
 def count_materialized(hlo_text: str, dtype_str: str, last_dim: int,
@@ -299,18 +282,14 @@ def copy_census(cfg, B: int = 4) -> dict:
             state_abs, batch_abs, scalars_abs, rng_abs).compile()
     donation_warnings = [str(w.message) for w in caught
                          if "donat" in str(w.message).lower()]
-    text = compiled.as_text()
-    counts = {"copy": 0, "copy-start": 0, "copy-done": 0,
-              "dynamic-update-slice": 0}
-    for line in non_fusion_lines(text):
-        for op in counts:
-            if re.search(r"=\s*\S+\s+" + re.escape(op) + r"\(", line):
-                counts[op] += 1
-    return {
-        "hlo_copy_ops": counts,
-        "hlo_copy_total": sum(counts.values()),
-        "donation_warnings": donation_warnings,
-    }
+    from dinov3_tpu.utils import hlo_copy_census
+
+    # per-category attribution (rng / donation_async / small / large):
+    # a future copy regression names its source instead of only moving
+    # the total (utils.classify_copy documents the category heuristics)
+    rec = hlo_copy_census(compiled.as_text())
+    rec["donation_warnings"] = donation_warnings
+    return rec
 
 
 def main():

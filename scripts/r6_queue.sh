@@ -21,12 +21,23 @@
 #         this measures what the TPU does with each form. A second pair
 #         pins train.centering=softmax_center where the streaming win
 #         is the large one.
+#   phR   step-wide RNG-plan engine A/B (the 14.8% copy/small-op
+#         attack, rng/plan.py): default program (rng.plan auto=on) vs
+#         rng.plan=false legacy fold_in control, same session, both
+#         arms pinned BENCH_PROBS=bf16 at B=12 and both carrying the
+#         compiled-step copy census in their records (BENCH_CENSUS=1).
+#         Host-side accounting (scripts/cost_rng_copies.py,
+#         COST_RNG_r08.json): -72.2% copy-class HLO ops in the compiled
+#         step (518 -> 144; the removed ops are the u32 RNG-key
+#         plumbing, per-category attribution in the artifact); this
+#         measures what the TPU scheduler does with each form.
 #   phG2  fixed op-level flash-vs-dense attention crossover
-#         (scripts/bench_attention_crossover.py): the
+#         (scripts/crossover_attention.py): the
 #         kernels.flash_min_seq=2048 boundary is measured only at
 #         N=201/1029 full-step points; 2048-2309 and the flash side are
 #         unmeasured (ADVICE r5 low). Seconds-long compiles, banks the
-#         crossover table the threshold cites.
+#         crossover table + the executable recommended_flash_min_seq
+#         the threshold cites.
 # Every bench.py record now embeds the fixed calibration rung
 # ("calib"), so these rows are comparable across sessions.
 #
@@ -139,13 +150,22 @@ run_bench phS_sc_stream_on 2100 pinned BENCH_PROBS=bf16 \
 run_bench phS_sc_stream_off_ctl 2100 pinned BENCH_PROBS=bf16 \
     BENCH_OVERRIDES=train.centering=softmax_center,loss.streaming_targets=false
 
+# phR: step-wide RNG-plan engine A/B. Treatment = the committed default
+# program (rng.plan auto = on); control strips ONLY the engine (legacy
+# fold_in chains). Both arms embed the compiled-step copy census in
+# their records so the throughput delta and the copy-count delta land
+# in the same JSONL row.
+run_bench phR_rngplan_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1
+run_bench phR_rngplan_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=rng.plan=false
+
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including
 # the unmeasured 2048-2309 band and the flash side at N>=2309).
 if gate_phase 2400 phG2_attn_crossover; then
     note "start phG2_attn_crossover"
     rm -f /tmp/attn_crossover_r6.jsonl
-    if timeout 2400 python scripts/bench_attention_crossover.py \
+    if timeout 2400 python scripts/crossover_attention.py \
             /tmp/attn_crossover_r6.jsonl >> "$LOG" 2>&1; then
         note "done  phG2_attn_crossover -> /tmp/attn_crossover_r6.jsonl"
         while IFS= read -r line; do
