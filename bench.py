@@ -584,7 +584,10 @@ def main():
     _log(f"calibration: {calib}")
 
     _phase("build")
-    from dinov3_tpu.configs.config import warn_bad_batch_tiling
+    from dinov3_tpu.configs.config import (
+        warn_bad_batch_tiling,
+        warn_student_row_tiling,
+    )
 
     tiling_warning = warn_bad_batch_tiling(per_chip)
     cfg = get_default_config()
@@ -594,6 +597,12 @@ def main():
         extra=_split_overrides(os.environ.get("BENCH_OVERRIDES", "")),
     )
     apply_dot_overrides(cfg, overrides)
+    # same guardrail over the benched program's other student row axes
+    # (local-crop rows / packed row count) — recorded with the batch one
+    row_warnings = warn_student_row_tiling(cfg, per_chip)
+    if row_warnings:
+        tiling_warning = "; ".join(
+            ([tiling_warning] if tiling_warning else []) + row_warnings)
     B = per_chip * n
     batch_np = make_synthetic_batch(cfg, B, seed=0)
     batch = {k: jnp.asarray(v) for k, v in batch_np.items()}

@@ -177,6 +177,11 @@ def load_config(
     # batch-tiling guardrail: a silent 2.4x cliff is a footgun in a
     # framework whose selling point is TPU-first layout awareness
     warn_bad_batch_tiling(cfg.train.batch_size_per_device)
+    # ... and the same guardrail over the student's OTHER row axes: the
+    # local-crop row axis (n_l*B, the two-pass program) or the packed
+    # row count (2B + P, the crop-packed program) — 96 rows of 37
+    # tokens is precisely the pathology the packing engine removes
+    warn_student_row_tiling(cfg)
     return cfg
 
 
@@ -231,22 +236,26 @@ def nearest_good_batch_sizes(per_chip_batch: int) -> tuple[int, int]:
 
 
 def warn_bad_batch_tiling(
-    per_chip_batch: int, threshold: float = 0.2, stacklevel: int = 2
+    per_chip_batch: int, threshold: float = 0.2, stacklevel: int = 2,
+    axis: str = "per-chip batch",
 ) -> str | None:
-    """Warn when the per-chip batch pads >``threshold`` on the sublane
+    """Warn when a per-chip row count pads >``threshold`` on the sublane
     axis — the measured 2.4x throughput cliff (B=10: 24.22 vs 58.56
     img/s/chip at B=12, same-session A/B, ``MEASUREMENTS_r5.md`` phC
     rows, docs/PERFORMANCE.md). Called at config build (``load_config``) and
     by ``bench.py`` so nobody walks into the cliff silently. Returns the
-    warning message, or None when the size tiles fine.
+    warning message, or None when the size tiles fine. ``axis`` names
+    the row axis being guarded (the per-chip global batch by default;
+    ``warn_student_row_tiling`` reuses this for the local-crop and
+    packed row axes).
     """
     waste = sublane_padding_waste(per_chip_batch)
     if waste <= threshold:
         return None
     lo, hi = nearest_good_batch_sizes(per_chip_batch)
     msg = (
-        f"per-chip batch {per_chip_batch} pads {waste:.0%} on the TPU "
-        f"sublane axis — a measured 2.4x throughput cliff (B=10 ran "
+        f"{axis} {per_chip_batch} pads {waste:.0%} on the TPU "
+        f"sublane axis — the measured-cliff class (B=10 ran "
         f"24.22 img/s/chip vs 58.56 at B=12, same session, "
         f"MEASUREMENTS_r5.md / docs/PERFORMANCE.md). Use "
         f"{lo} or {hi} instead."
@@ -255,6 +264,51 @@ def warn_bad_batch_tiling(
 
     warnings.warn(msg, stacklevel=stacklevel + 1)
     return msg
+
+
+def crop_packing_wished(cfg: ConfigNode) -> bool:
+    """Whether the config ASKS for crop packing (before the meta arch's
+    pipeline/convnext/k<2 auto-fallbacks, ssl_meta_arch.py)."""
+    cp = (cfg.get("model") or {}).get("crop_packing", "auto")
+    if isinstance(cp, str):
+        return cp.lower() in ("auto", "true", "on")
+    return bool(cp)
+
+
+def warn_student_row_tiling(
+    cfg: ConfigNode, per_chip_batch: int | None = None,
+    threshold: float = 0.2, stacklevel: int = 2,
+) -> list[str]:
+    """Sublane guardrail over the student's crop row axes.
+
+    Two-pass program (``model.crop_packing=false`` or any auto
+    fallback): the local-crop row axis ``n_l * B`` — 96 rows of
+    37-token sequences at the B=12 default was exactly the
+    tiling pathology the original guardrail existed for. Crop-packed
+    program: the packed row count ``2B + ceil(n_l*B / k)``
+    (ops/packing.py). Returns the warning messages (empty when every
+    axis tiles fine).
+    """
+    from dinov3_tpu.ops.packing import layout_from_cfg
+
+    B = int(per_chip_batch if per_chip_batch is not None
+            else cfg.train.batch_size_per_device)
+    n_l = int(cfg.crops.local_crops_number)
+    layout = layout_from_cfg(cfg, B)
+    msgs = []
+    if crop_packing_wished(cfg) and layout is not None and layout.k >= 2:
+        m = warn_bad_batch_tiling(
+            layout.rows_total, threshold, stacklevel + 1,
+            axis="packed student row count (2B + ceil(n_l*B/k))")
+        if m:
+            msgs.append(m)
+    else:
+        m = warn_bad_batch_tiling(
+            n_l * B, threshold, stacklevel + 1,
+            axis="local-crop row axis (n_l*B)")
+        if m:
+            msgs.append(m)
+    return msgs
 
 
 def apply_scaling_rules_to_cfg(cfg: ConfigNode) -> ConfigNode:

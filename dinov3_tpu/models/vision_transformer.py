@@ -121,39 +121,52 @@ class DinoVisionTransformer(nn.Module):
 
     # ---------------- token preparation ----------------
 
-    def _prepare_tokens(self, x, masks):
-        """[B, H, W, C] -> ([B, 1+S+T, D], (h, w)). masks: [B, T] bool."""
-        B = x.shape[0]
-        h, w = x.shape[1] // self.patch_size, x.shape[2] // self.patch_size
-        tokens = PatchEmbed(
+    def _token_embedder(self):
+        """Create the patch-embed module + token params ONCE per apply —
+        the packed forward embeds the global and local crops with the
+        same instances (a second creation would collide on names)."""
+        patch_embed = PatchEmbed(
             embed_dim=self.embed_dim, patch_size=self.patch_size,
             in_chans=self.in_chans, dtype=self.dtype,
             param_dtype=self.param_dtype, name="patch_embed",
-        )(x)
+        )
         mask_token = self.param(
             "mask_token", part(nn.initializers.zeros, ("embed",)),
             (self.embed_dim,), self.param_dtype,
         )
-        if masks is not None:
-            tokens = jnp.where(
-                masks[..., None], mask_token.astype(tokens.dtype), tokens
-            )
         cls_token = self.param(
             "cls_token", part(nn.initializers.normal(0.02), (None, None, "embed")),
             (1, 1, self.embed_dim), self.param_dtype,
         )
-        parts = [jnp.broadcast_to(cls_token.astype(tokens.dtype),
-                                  (B, 1, self.embed_dim))]
+        storage = None
         if self.n_storage_tokens > 0:
             storage = self.param(
                 "storage_tokens",
                 part(nn.initializers.normal(0.02), (None, None, "embed")),
                 (1, self.n_storage_tokens, self.embed_dim), self.param_dtype,
             )
+        return patch_embed, mask_token, cls_token, storage
+
+    def _embed_tokens(self, embedder, x, masks):
+        """[B, H, W, C] -> ([B, 1+S+T, D], (h, w)). masks: [B, T] bool."""
+        patch_embed, mask_token, cls_token, storage = embedder
+        B = x.shape[0]
+        h, w = x.shape[1] // self.patch_size, x.shape[2] // self.patch_size
+        tokens = patch_embed(x)
+        if masks is not None:
+            tokens = jnp.where(
+                masks[..., None], mask_token.astype(tokens.dtype), tokens
+            )
+        parts = [jnp.broadcast_to(cls_token.astype(tokens.dtype),
+                                  (B, 1, self.embed_dim))]
+        if storage is not None:
             parts.append(jnp.broadcast_to(storage.astype(tokens.dtype),
                                           (B, self.n_storage_tokens, self.embed_dim)))
         parts.append(tokens)
         return jnp.concatenate(parts, axis=1), (h, w)
+
+    def _prepare_tokens(self, x, masks):
+        return self._embed_tokens(self._token_embedder(), x, masks)
 
     def _rope_table(self, h: int, w: int, deterministic: bool,
                     aug: dict | None = None):
@@ -211,7 +224,7 @@ class DinoVisionTransformer(nn.Module):
         )
 
     def _run_blocks(self, x, rope, deterministic, collect: Sequence[int] = (),
-                    plan: dict | None = None):
+                    plan: dict | None = None, seg=None):
         """Run the stack; optionally collect outputs of the listed layers.
 
         Every path composes with every other feature: MoE aux losses ride
@@ -224,11 +237,20 @@ class DinoVisionTransformer(nn.Module):
         it as per-layer scan inputs (``in_axes=0`` — a dynamic-slice of
         the carried stack, not a folded key); the unrolled stack as
         static slices. The pipeline path keeps the legacy per-stage rng
-        threading (the meta-arch never hands it a plan)."""
+        threading (the meta-arch never hands it a plan).
+
+        ``seg``: [B, N] segment ids of the crop-packed batch — broadcast
+        to every block like rope (not supported on the pipeline path;
+        the meta arch falls back to two passes there)."""
         collected = {}
         if self.pipeline_stages > 1:
             from dinov3_tpu.parallel.pipeline import PipelinedBlocks
 
+            if seg is not None:
+                raise ValueError(
+                    "crop packing is not supported under pipeline "
+                    "parallelism (the meta arch falls back to the "
+                    "two-pass student forward there)")
             x, collected = PipelinedBlocks(
                 block_kwargs=self._block_kwargs(),
                 n_blocks=self.n_blocks,
@@ -243,11 +265,11 @@ class DinoVisionTransformer(nn.Module):
                 variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "drop_path": True, "dropout": True},
                 in_axes=(0 if plan is not None else nn.broadcast,
-                         nn.broadcast, nn.broadcast),
+                         nn.broadcast, nn.broadcast, nn.broadcast),
                 length=self.n_blocks,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(block_kwargs=self._block_kwargs(), remat=self.remat, name="blocks")
-            x, _ = scanned(x, plan, rope, deterministic)
+            x, _ = scanned(x, plan, rope, deterministic, seg)
         elif self.scan_layers:
             take = tuple(sorted(collect))
             scanned = nn.scan(
@@ -272,7 +294,7 @@ class DinoVisionTransformer(nn.Module):
             for i in range(self.n_blocks):
                 x = remat_block_cls(self.remat)(
                     **self._block_kwargs(), name=f"blocks_{i}"
-                )(x, rope, deterministic, plan_layer_slice(plan, i))
+                )(x, rope, deterministic, plan_layer_slice(plan, i), seg)
                 if i in collect:
                     collected[i] = x
         return x, collected
@@ -330,6 +352,7 @@ class DinoVisionTransformer(nn.Module):
         crop_kind: str = "global",
         deterministic: bool = True,
         rng_plan: dict | None = None,
+        local_crops: jnp.ndarray | None = None,
     ) -> dict:
         """Forward a batch of same-resolution crops.
 
@@ -340,9 +363,21 @@ class DinoVisionTransformer(nn.Module):
         Returns the reference's feature dict (vision_transformer.py:236-243):
         x_norm_clstoken [B, D], x_storage_tokens [B, S, D],
         x_norm_patchtokens [B, T, D], x_prenorm, masks.
+
+        ``local_crops``: optional [n_l*B, h, w, C] — the crop-packed
+        single-pass engine (ops/packing.py, model.crop_packing): local
+        sequences are packed k-per-row into global-length rows and run
+        through ONE block stack with the globals, under segment-masked
+        attention and per-segment RoPE. The returned dict then also
+        carries "local_cls" [n_l*B, D] (and "local_storage_tokens");
+        ``rng_plan["rope"]`` is the nested {"global": ..., "local": ...}
+        per-table form there.
         """
         rng_plan = rng_plan or {}
         norms = self._make_norms()
+        if local_crops is not None:
+            return self._packed_forward(
+                x, masks, local_crops, norms, deterministic, rng_plan)
         tokens, (h, w) = self._prepare_tokens(x, masks)
         rope = self._rope_table(h, w, deterministic,
                                 aug=rng_plan.get("rope"))
@@ -358,6 +393,106 @@ class DinoVisionTransformer(nn.Module):
             "x_prenorm": out,
             "masks": masks,
         }
+
+    def _packed_forward(self, x, masks, local_crops, norms, deterministic,
+                        rng_plan):
+        """Crop-packed single-pass student forward (ops/packing.py).
+
+        One block scan over [2B + P, N_g] rows — the ViT-L weight stack
+        streams from HBM once per direction instead of twice, and the
+        ~37-token local rows disappear into well-tiled global-length
+        rows (the ISSUE-4 engine; oracle = the two-pass path behind
+        ``model.crop_packing=false``). Per-token math is identical to
+        the two-pass oracle: packing only changes which rows share an
+        attention call, and segments are attention-isolated, so
+        packed-vs-oracle equivalence holds to float reassociation
+        (pinned in tests/test_crop_packing.py).
+        """
+        from dinov3_tpu.ops.packing import (
+            assemble_packed_batch,
+            make_packed_layout,
+            pack_local_rows,
+            packed_segment_ids,
+            split_packed_output,
+        )
+        from dinov3_tpu.parallel.sharding import (
+            constrain_packed_rows,
+            packed_row_groups,
+        )
+
+        embedder = self._token_embedder()
+        g_tokens, (hg, wg) = self._embed_tokens(embedder, x, masks)
+        l_tokens, (hl, wl) = self._embed_tokens(embedder, local_crops, None)
+        n_prefix = 1 + self.n_storage_tokens
+        layout = make_packed_layout(
+            n_global_rows=g_tokens.shape[0], n_local=l_tokens.shape[0],
+            seq_global=g_tokens.shape[1], seq_local=l_tokens.shape[1],
+            n_prefix=n_prefix, groups=packed_row_groups(),
+        )
+        if layout.k < 2:
+            raise ValueError(
+                f"crop packing needs k >= 2 local sequences per global "
+                f"row (N_g={layout.seq_global}, N_l={layout.seq_local}); "
+                "the meta arch guards this and falls back to two passes")
+        with jax.named_scope("crop_pack"):
+            packed = pack_local_rows(l_tokens, layout)
+            tokens = constrain_packed_rows(
+                assemble_packed_batch(g_tokens, packed, layout))
+        seg = jnp.asarray(packed_segment_ids(layout))
+        rope = self._packed_rope(layout, (hg, wg), (hl, wl), deterministic,
+                                 rng_plan.get("rope"))
+        out, _ = self._run_blocks(tokens, rope, deterministic,
+                                  plan=rng_plan.get("drop_path"), seg=seg)
+        with jax.named_scope("crop_unpack"):
+            g_rows, p_rows = split_packed_output(out, layout)
+            l_tok = p_rows[:, : layout.k * layout.seq_local, :]
+            l_prefix = l_tok.reshape(
+                layout.n_packed_rows * layout.k, layout.seq_local, -1
+            )[: layout.n_local, :n_prefix]
+        x_cls_reg, x_patch = self._final_norms(
+            g_rows, norms, crop_kind="global", deterministic=deterministic
+        )
+        # the local-CLS norm choice _final_norms would make for
+        # crop_kind="local" (norms are per-token, so norm-after-extract
+        # == the oracle's extract-after-norm)
+        if self.untie_global_and_local_cls_norm and not deterministic:
+            local_norm = norms["local_cls_norm"]
+        elif self.untie_cls_and_patch_norms:
+            local_norm = norms["cls_norm"]
+        else:
+            local_norm = norms["norm"]
+        l_cls_reg = local_norm(l_prefix)
+        return {
+            "x_norm_clstoken": x_cls_reg[:, 0],
+            "x_storage_tokens": x_cls_reg[:, 1:],
+            "x_norm_patchtokens": x_patch,
+            "x_prenorm": out,
+            "masks": masks,
+            "local_cls": l_cls_reg[:, 0],
+            "local_storage_tokens": l_cls_reg[:, 1:],
+        }
+
+    def _packed_rope(self, layout, global_hw, local_hw, deterministic,
+                     rope_plan):
+        """Per-row (sin, cos) tables for the packed batch, or None.
+
+        ``rope_plan``: the packed pass's nested aug-factor dict
+        ({"global": ..., "local": ...}, rng/plan.py) — each sub-table
+        consumes its own lane, bitwise-identical to the factors the
+        two-pass oracle's global/local passes would consume. On the
+        legacy rng path each ``_rope_table`` call draws its own
+        ``make_rng`` fold, mirroring the oracle's two per-pass draws.
+        """
+        if self.pos_embed_type != "rope":
+            return None
+        rope_plan = rope_plan or {}
+        from dinov3_tpu.ops.rope import rope_packed_rows
+
+        g_table = self._rope_table(*global_hw, deterministic,
+                                   aug=rope_plan.get("global"))
+        l_table = self._rope_table(*local_hw, deterministic,
+                                   aug=rope_plan.get("local"))
+        return rope_packed_rows(g_table, l_table, layout)
 
     @nn.compact
     def get_intermediate_layers(

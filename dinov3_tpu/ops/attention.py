@@ -76,18 +76,31 @@ def xla_attention(
     reduce_dtype=jnp.float32,
     causal: bool = False,
     probs_dtype=None,
+    seg: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Unfused attention: [B, N, h, d] inputs, softmax in reduce_dtype.
 
     ``probs_dtype``: storage dtype of the probabilities (fp32 statistics
     either way). bf16 halves the [B, h, N, N] HBM traffic — the recipe
     default via ``compute_precision.probs_dtype`` — while ``None`` keeps
-    full-precision residuals (module default; bitwise-stable tests)."""
+    full-precision residuals (module default; bitwise-stable tests).
+
+    ``seg``: optional [B, N] int32 segment ids (crop packing,
+    ops/packing.py): token q attends token k iff seg[b,q] == seg[b,k] —
+    block-diagonal attention, so packed crops never see each other.
+    Masked logits get a large finite negative (the flash kernel's
+    NEG_INF convention): their exp underflows to exactly 0 after the
+    row-max shift (every token matches itself, so the max is always a
+    real logit), which keeps packed-vs-unpacked softmax sums bitwise
+    clean and — unlike -inf — cannot produce NaN for any row."""
     d = q.shape[-1]
     scale = d ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=reduce_dtype)
     logits = (logits * scale).astype(reduce_dtype)
+    if seg is not None:
+        same = seg[:, None, :, None] == seg[:, None, None, :]
+        logits = jnp.where(same, logits, jnp.asarray(-1e30, logits.dtype))
     if causal:
         N = q.shape[1]
         row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, N, N), 2)
@@ -148,6 +161,7 @@ def dispatch_attention(
     impl: str = "auto", reduce_dtype=jnp.float32,
     flash_block_q: int = 512, flash_block_kv: int = 512,
     probs_dtype=None, flash_min_seq: int = 0,
+    seg: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     if impl == "auto":
         # 0/None = built-in default, matching kernels.flash_min_seq's
@@ -163,12 +177,13 @@ def dispatch_attention(
             else "xla"
         )
     if impl in ("xla", "reference"):
-        return xla_attention(q, k, v, reduce_dtype, probs_dtype=probs_dtype)
+        return xla_attention(q, k, v, reduce_dtype, probs_dtype=probs_dtype,
+                             seg=seg)
     if impl == "pallas":
         from dinov3_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, block_q=flash_block_q,
-                               block_kv=flash_block_kv)
+                               block_kv=flash_block_kv, seg=seg)
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
@@ -197,7 +212,11 @@ class SelfAttention(nn.Module):
         x: jnp.ndarray,
         rope: tuple[jnp.ndarray, jnp.ndarray] | None = None,
         deterministic: bool = True,
+        seg: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
+        """``seg``: optional [B, N] segment ids for block-diagonal
+        (crop-packed) attention; ``rope`` tables may then be per-row
+        [B, N, head_dim] (global vs packed coordinate grids)."""
         B, N, _ = x.shape
         h, d = self.num_heads, self.dim // self.num_heads
 
@@ -246,7 +265,10 @@ class SelfAttention(nn.Module):
             # reference kept a CausalSelfAttention for generative probes)
             out = xla_attention(q, k, v, self.reduce_dtype, causal=True,
                                 probs_dtype=self.probs_dtype)
-        if out is None and self.seq_parallel:
+        if out is None and self.seq_parallel and seg is None:
+            # ring attention has no segment masking; the meta arch never
+            # combines crop packing with seq parallelism (it falls back
+            # loudly), so seg here only occurs in direct module use
             from dinov3_tpu.parallel.context import get_current_mesh
 
             mesh = get_current_mesh()
@@ -262,6 +284,7 @@ class SelfAttention(nn.Module):
                 flash_block_kv=self.flash_block_kv,
                 probs_dtype=self.probs_dtype,
                 flash_min_seq=self.flash_min_seq,
+                seg=seg,
             )
         out = constrain(out.reshape(B, N, self.dim), ("batch", None, "embed_act"))
 

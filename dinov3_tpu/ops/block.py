@@ -61,13 +61,20 @@ class SelfAttentionBlock(nn.Module):
         rope: tuple[jnp.ndarray, jnp.ndarray] | None = None,
         deterministic: bool = True,
         dp_plan: dict | None = None,
+        seg: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         """``dp_plan``: this block's slice of the step-wide RNG plan
         (rng/plan.py) — {"idx": [2, keep]} (subset kept rows) or
         {"keep": [2, B]} (mask bits), one entry per residual branch.
         When given, the block consumes precomputed randomness and calls
         ``make_rng`` for NOTHING; when None, the legacy per-branch
-        fold_in path runs (the rng.plan=false oracle)."""
+        fold_in path runs (the rng.plan=false oracle).
+
+        ``seg``: [B, N] segment ids of the crop-packed batch
+        (ops/packing.py) — attention becomes block-diagonal, and the
+        rope tables are per-row [B, N, head_dim]. Both are per-ROW
+        arrays, so the subset drop-path gather must carry them along
+        with the kept rows (the ``aux`` threading below)."""
         norm_kw = dict(param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype)
         ls = (
             (lambda name: LayerScale(self.layerscale_init, self.param_dtype, name=name))
@@ -95,11 +102,17 @@ class SelfAttentionBlock(nn.Module):
             param_dtype=self.param_dtype, name="mlp",
         )
 
-        def attn_branch(t):
-            return ls("ls1")(attn(norm1(t), rope=rope,
-                                  deterministic=deterministic))
+        # per-row context (crop packing): the subset gather must carry
+        # the rows' own rope tables / segment ids next to the rows
+        aux = {"rope": rope, "seg": seg} if seg is not None else None
 
-        def mlp_branch(t):
+        def attn_branch(t, a=None):
+            r = a["rope"] if a is not None else rope
+            s = a["seg"] if a is not None else seg
+            return ls("ls1")(attn(norm1(t), rope=r,
+                                  deterministic=deterministic, seg=s))
+
+        def mlp_branch(t, a=None):
             return ls("ls2")(mlp(norm2(t), deterministic=deterministic))
 
         dropping = self.drop_path_rate > 0.0 and not deterministic
@@ -108,8 +121,10 @@ class SelfAttentionBlock(nn.Module):
             # was made at plan build through the SAME resolve_drop_path,
             # so the key present in the slice is the decision
             if "idx" in dp_plan:
-                x = subset_residual_planned(x, attn_branch, dp_plan["idx"][0])
-                x = subset_residual_planned(x, mlp_branch, dp_plan["idx"][1])
+                x = subset_residual_planned(x, attn_branch, dp_plan["idx"][0],
+                                            aux=aux)
+                x = subset_residual_planned(x, mlp_branch, dp_plan["idx"][1],
+                                            aux=aux)
             else:
                 x = mask_residual_planned(
                     x, attn_branch(x), dp_plan["keep"][0],
@@ -138,10 +153,12 @@ class SelfAttentionBlock(nn.Module):
             # compute, not just the residual
             x = subset_residual(x, attn_branch,
                                 self.make_rng("drop_path"),
-                                self.drop_path_rate, groups=groups)
+                                self.drop_path_rate, groups=groups,
+                                aux=aux)
             x = subset_residual(x, mlp_branch,
                                 self.make_rng("drop_path"),
-                                self.drop_path_rate, groups=groups)
+                                self.drop_path_rate, groups=groups,
+                                aux=aux)
         else:
             dp = DropPath(self.drop_path_rate)
             x = x + dp(attn_branch(x), deterministic=deterministic)
@@ -195,10 +212,10 @@ class ScanBlockAdapter(nn.Module):
     remat: str = "none"
 
     @nn.compact
-    def __call__(self, x, dp_plan, rope, deterministic: bool):
+    def __call__(self, x, dp_plan, rope, deterministic: bool, seg=None):
         x = remat_block_cls(self.remat)(
             **self.block_kwargs, name="block"
-        )(x, rope, deterministic, dp_plan)
+        )(x, rope, deterministic, dp_plan, seg)
         return x, None
 
 

@@ -90,12 +90,27 @@ def resolve_drop_path(batch: int, rate: float, mode: str,
     return "subset", G
 
 
+def _branch_on(branch, xs, aux, idx=None):
+    """Invoke a residual branch on a row subset, gathering any per-row
+    auxiliary arrays (crop packing's rope tables / segment ids,
+    ops/packing.py) with the same kept-row indices so the branch's
+    attention sees the rows' own coordinates and segments."""
+    if aux is None:
+        return branch(xs)
+    if idx is not None:
+        aux = jax.tree.map(
+            lambda a: jnp.take(a, idx, axis=0, unique_indices=True,
+                               indices_are_sorted=True), aux)
+    return branch(xs, aux)
+
+
 def subset_residual(
     x: jnp.ndarray,
     branch: Callable[[jnp.ndarray], jnp.ndarray],
     rng: jax.Array,
     rate: float,
     groups: int = 1,
+    aux=None,
 ) -> jnp.ndarray:
     """x + drop-path(branch) with the reference's batch-subset semantics.
 
@@ -118,7 +133,7 @@ def subset_residual(
     Bg = B // groups
     keep_g = subset_keep_count(Bg, rate)
     if keep_g >= Bg:
-        return x + branch(x).astype(x.dtype)
+        return x + _branch_on(branch, x, aux).astype(x.dtype)
     if groups == 1:
         idx = jnp.sort(jax.random.permutation(rng, B)[:keep_g])
     else:
@@ -131,7 +146,7 @@ def subset_residual(
         idx = jnp.sort(perms, axis=1).reshape(-1) + offs.reshape(-1).repeat(keep_g)
     xs = jnp.take(x, idx, axis=0, unique_indices=True,
                   indices_are_sorted=True)
-    res = branch(xs) * (Bg / keep_g)
+    res = _branch_on(branch, xs, aux, idx) * (Bg / keep_g)
     return x.at[idx].add(res.astype(x.dtype), indices_are_sorted=True,
                          unique_indices=True, mode="promise_in_bounds")
 
@@ -140,6 +155,7 @@ def subset_residual_planned(
     x: jnp.ndarray,
     branch: Callable[[jnp.ndarray], jnp.ndarray],
     idx: jnp.ndarray,
+    aux=None,
 ) -> jnp.ndarray:
     """``subset_residual`` consuming a PRECOMPUTED kept-index vector.
 
@@ -154,7 +170,7 @@ def subset_residual_planned(
     B, keep = x.shape[0], idx.shape[0]
     xs = jnp.take(x, idx, axis=0, unique_indices=True,
                   indices_are_sorted=True)
-    res = branch(xs) * (B / keep)
+    res = _branch_on(branch, xs, aux, idx) * (B / keep)
     return x.at[idx].add(res.astype(x.dtype), indices_are_sorted=True,
                          unique_indices=True, mode="promise_in_bounds")
 

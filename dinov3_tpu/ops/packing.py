@@ -1,0 +1,229 @@
+"""Crop packing: k local-crop token sequences per global-length row.
+
+The two-pass student forward runs the backbone once on [2B, N_g] global
+rows and once on [n_l*B, N_l] local rows — the ViT-L weight stack
+streams from HBM twice per forward (and twice again per backward), and
+the 37-token local rows tile terribly on the 128-lane axis (the same
+padding-cliff class as the B=10 sublane guardrail,
+configs/config.py sublane_padding_waste). GSPMD (arXiv:2105.04663)
+quantifies the general point: once the matmuls sit at the roofline,
+padding waste and per-op overhead are what remain.
+
+This module holds the pure layout math and token assembly for the
+crop-packed single-pass engine (``model.crop_packing``,
+train/ssl_meta_arch.py): pack ``k = N_g // N_l`` local sequences into
+each global-length row, concatenate with the global rows, and run ONE
+backbone apply — one block scan, ~44 well-tiled rows instead of 120 at
+ViT-L B=12 — under segment-masked (block-diagonal) attention so packed
+crops never attend across segments (ops/attention.py seg argument,
+ops/flash_attention.py seg kernels) and per-segment RoPE tables
+(ops/rope.py rope_packed_rows).
+
+Row order is *data-shard grouped* when a mesh with a >1-way data axis
+is current (``groups`` below): the packed batch is laid out as
+[shard0's globals, shard0's packed rows, shard1's globals, ...], so the
+even GSPMD sharding of the concatenated row axis coincides with a
+shard-local concatenation — no cross-shard row movement at the pack
+boundary (parallel/sharding.py ``constrain_packed_rows`` pins the
+axis). With ``groups=1`` (no mesh, CPU tests) the order degenerates to
+the plain [globals..., packed...] concatenation.
+
+Pad tokens (the row tail beyond ``k*N_l`` and the missing segments of
+the ragged last row) carry segment id -1: they attend only among
+themselves (never an empty softmax row, so no NaN can leak into the
+backward) and no valid token attends to them; their outputs are
+dropped at extraction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedLayout:
+    """Static shape plan for one crop-packed student batch."""
+
+    n_global_rows: int   # 2B global-crop rows
+    n_local: int         # n_l * B local-crop sequences
+    seq_global: int      # N_g = n_prefix + T_g
+    seq_local: int       # N_l = n_prefix + T_l
+    n_prefix: int        # 1 + n_storage_tokens (CLS + registers)
+    groups: int = 1      # data-shard row grouping (see module doc)
+
+    @property
+    def k(self) -> int:
+        """Local sequences packed per global-length row."""
+        return self.seq_global // self.seq_local
+
+    @property
+    def n_packed_rows(self) -> int:
+        """P = ceil(n_local / k)."""
+        return -(-self.n_local // self.k)
+
+    @property
+    def rows_total(self) -> int:
+        return self.n_global_rows + self.n_packed_rows
+
+    @property
+    def pad_segments(self) -> int:
+        """Empty segment slots in the ragged last packed row."""
+        return self.n_packed_rows * self.k - self.n_local
+
+    @property
+    def pad_tokens_per_row(self) -> int:
+        """Row-tail tokens beyond the k packed segments."""
+        return self.seq_global - self.k * self.seq_local
+
+    @property
+    def pad_waste(self) -> float:
+        """Fraction of packed-row tokens that are padding (tail pads +
+        the ragged row's empty segments)."""
+        computed = self.n_packed_rows * self.seq_global
+        useful = self.n_local * self.seq_local
+        return (computed - useful) / computed
+
+
+def make_packed_layout(n_global_rows: int, n_local: int, seq_global: int,
+                       seq_local: int, n_prefix: int,
+                       groups: int = 1) -> PackedLayout:
+    if seq_local > seq_global:
+        raise ValueError(
+            f"local sequence ({seq_local}) longer than global "
+            f"({seq_global}); nothing to pack")
+    layout = PackedLayout(
+        n_global_rows=n_global_rows, n_local=n_local,
+        seq_global=seq_global, seq_local=seq_local, n_prefix=n_prefix,
+        groups=max(1, int(groups)),
+    )
+    if layout.groups > 1 and (
+            n_global_rows % layout.groups or
+            layout.n_packed_rows % layout.groups):
+        # indivisible row counts: fall back to the ungrouped order (the
+        # sharding constraint then no-ops; GSPMD still partitions what
+        # it can)
+        layout = dataclasses.replace(layout, groups=1)
+    return layout
+
+
+def seq_len_from_crop(crop_size, patch_size: int, n_prefix: int) -> int:
+    s = crop_size
+    if isinstance(s, (list, tuple)):
+        s = int(s[0])
+    return n_prefix + (int(s) // int(patch_size)) ** 2
+
+
+def layout_from_cfg(cfg, per_chip_batch: int,
+                    groups: int = 1) -> PackedLayout | None:
+    """Config-level layout (the guardrail / cost-script view), or None
+    when the config has no packable ViT crop geometry (convnext)."""
+    s = cfg.student
+    if str(s.arch).startswith("convnext"):
+        return None
+    n_prefix = 1 + int(s.get("n_storage_tokens", 0) or 0)
+    seq_g = seq_len_from_crop(cfg.crops.global_crops_size, s.patch_size,
+                              n_prefix)
+    seq_l = seq_len_from_crop(cfg.crops.local_crops_size, s.patch_size,
+                              n_prefix)
+    if seq_l > seq_g:
+        return None
+    B = int(per_chip_batch)
+    return make_packed_layout(
+        n_global_rows=2 * B,
+        n_local=int(cfg.crops.local_crops_number) * B,
+        seq_global=seq_g, seq_local=seq_l, n_prefix=n_prefix,
+        groups=groups,
+    )
+
+
+# ---------------- token assembly ----------------
+
+
+def pack_local_rows(l_tokens, layout: PackedLayout):
+    """[n_local, N_l, D] -> [P, N_g, D]: k sequences per row, zero pad.
+
+    Zero pad tokens are safe through the per-token ops (LayerNorm of a
+    zero vector is the bias; MLP is pointwise) and are attention-masked
+    by their -1 segment id; their outputs are dropped at extraction.
+    """
+    import jax.numpy as jnp
+
+    P, k, N_l = layout.n_packed_rows, layout.k, layout.seq_local
+    x = l_tokens
+    if layout.pad_segments:
+        x = jnp.pad(x, ((0, layout.pad_segments), (0, 0), (0, 0)))
+    x = x.reshape(P, k * N_l, x.shape[-1])
+    if layout.pad_tokens_per_row:
+        x = jnp.pad(x, ((0, 0), (0, layout.pad_tokens_per_row), (0, 0)))
+    return x
+
+
+def assemble_packed_batch(g_tokens, packed_rows, layout: PackedLayout):
+    """Concatenate global and packed rows in the shard-grouped order."""
+    import jax.numpy as jnp
+
+    g = layout.groups
+    if g <= 1:
+        return jnp.concatenate([g_tokens, packed_rows], axis=0)
+    gb = layout.n_global_rows // g
+    pb = layout.n_packed_rows // g
+    tail = g_tokens.shape[1:]
+    mixed = jnp.concatenate([
+        g_tokens.reshape((g, gb) + tail),
+        packed_rows.reshape((g, pb) + tail),
+    ], axis=1)
+    return mixed.reshape((layout.rows_total,) + tail)
+
+
+def split_packed_output(out, layout: PackedLayout):
+    """Inverse of ``assemble_packed_batch``: ([2B, N, D], [P, N, D])."""
+    g = layout.groups
+    tail = out.shape[1:]
+    if g <= 1:
+        return (out[: layout.n_global_rows],
+                out[layout.n_global_rows:])
+    gb = layout.n_global_rows // g
+    pb = layout.n_packed_rows // g
+    mixed = out.reshape((g, gb + pb) + tail)
+    return (mixed[:, :gb].reshape((layout.n_global_rows,) + tail),
+            mixed[:, gb:].reshape((layout.n_packed_rows,) + tail))
+
+
+def interleave_rows(plain_rows: np.ndarray, layout: PackedLayout) -> np.ndarray:
+    """Host-side reorder of a per-row [R, ...] array from the plain
+    [globals..., packed...] order into the shard-grouped order."""
+    g = layout.groups
+    if g <= 1:
+        return plain_rows
+    gb = layout.n_global_rows // g
+    pb = layout.n_packed_rows // g
+    perm = np.concatenate([
+        np.concatenate([
+            np.arange(s * gb, (s + 1) * gb),
+            layout.n_global_rows + np.arange(s * pb, (s + 1) * pb),
+        ]) for s in range(g)
+    ])
+    return plain_rows[perm]
+
+
+def packed_segment_ids(layout: PackedLayout) -> np.ndarray:
+    """[R, N_g] int32 segment ids (host constant).
+
+    Global rows are one segment (0). Packed row p, token t: segment
+    ``t // N_l`` while t < k*N_l and the slot p*k + t//N_l holds a real
+    local crop; -1 otherwise (row-tail pads and the ragged last row's
+    empty segments). Attention masks on per-row segment equality, so
+    global-row 0s never meet packed-row ids.
+    """
+    N, N_l, k = layout.seq_global, layout.seq_local, layout.k
+    t = np.arange(N)
+    base = np.where(t < k * N_l, t // N_l, -1)
+    pidx = np.arange(layout.n_packed_rows)[:, None]
+    slot = pidx * k + base[None, :]
+    seg_p = np.where((base[None, :] >= 0) & (slot < layout.n_local),
+                     base[None, :], -1)
+    seg_g = np.zeros((layout.n_global_rows, N), np.int64)
+    plain = np.concatenate([seg_g, seg_p], axis=0).astype(np.int32)
+    return interleave_rows(plain, layout)

@@ -199,7 +199,9 @@ def rope_apply_full(
     cos: jnp.ndarray,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Rotate q/k ([B, N, heads, head_dim]) by a full-length table
-    ([N, head_dim], identity rows for prefix tokens).
+    ([N, head_dim] shared by every row, or [B, N, head_dim] per-row —
+    the crop-packed batch, where global and packed rows carry different
+    coordinate grids; identity rows for prefix/pad tokens either way).
 
     Half-pair formulation (out1 = x1*c - x2*s; out2 = x2*c + x1*s) — the
     same math as ``rope_apply``'s rotate-half but with no negation pass,
@@ -208,8 +210,12 @@ def rope_apply_full(
     compute = jnp.promote_types(q.dtype, sin.dtype)
     half = sin.shape[-1] // 2
     # tables duplicate their halves ([ang, ang]); one half suffices
-    s = sin[None, :, None, :half].astype(compute)
-    c = cos[None, :, None, :half].astype(compute)
+    if sin.ndim == 3:
+        s = sin[:, :, None, :half].astype(compute)
+        c = cos[:, :, None, :half].astype(compute)
+    else:
+        s = sin[None, :, None, :half].astype(compute)
+        c = cos[None, :, None, :half].astype(compute)
 
     def rot(t):
         x = t.astype(compute)
@@ -218,6 +224,57 @@ def rope_apply_full(
         return out.astype(t.dtype)
 
     return rot(q), rot(k)
+
+
+def rope_packed_rows(
+    global_table: tuple[jnp.ndarray, jnp.ndarray],
+    local_table: tuple[jnp.ndarray, jnp.ndarray],
+    layout,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row RoPE tables for a crop-packed batch: ([R, N_g, d], x2).
+
+    ``global_table``/``local_table`` are full-length (sin, cos) tables
+    with their identity prefix rows already prepended
+    (``rope_with_identity_prefix``), [N_g, d] and [N_l, d]. The packed
+    rows tile the LOCAL table k times — each packed segment keeps its
+    own local patch grid (its own CLS identity row included) — and pad
+    the row tail with identity rotations; pad rotations are irrelevant
+    (pad tokens are segment-masked) but identity keeps them inert.
+    ``layout``: ops/packing.PackedLayout; row order follows its
+    shard-grouped convention (packing.assemble_packed_batch).
+    """
+    sin_g, cos_g = global_table
+    sin_l, cos_l = local_table
+    d = sin_g.shape[-1]
+    pad = layout.pad_tokens_per_row
+    sin_p = jnp.concatenate(
+        [jnp.tile(sin_l, (layout.k, 1)),
+         jnp.zeros((pad, d), sin_l.dtype)], axis=0)
+    cos_p = jnp.concatenate(
+        [jnp.tile(cos_l, (layout.k, 1)),
+         jnp.ones((pad, d), cos_l.dtype)], axis=0)
+    g, R = layout.groups, layout.rows_total
+    rows_g = jnp.broadcast_to(
+        sin_g[None], (layout.n_global_rows,) + sin_g.shape)
+    rows_gc = jnp.broadcast_to(
+        cos_g[None], (layout.n_global_rows,) + cos_g.shape)
+    rows_p = jnp.broadcast_to(
+        sin_p[None], (layout.n_packed_rows,) + sin_p.shape)
+    rows_pc = jnp.broadcast_to(
+        cos_p[None], (layout.n_packed_rows,) + cos_p.shape)
+    if g <= 1:
+        return (jnp.concatenate([rows_g, rows_p], axis=0),
+                jnp.concatenate([rows_gc, rows_pc], axis=0))
+    gb = layout.n_global_rows // g
+    pb = layout.n_packed_rows // g
+    tail = sin_g.shape
+
+    def grouped(a, b):
+        mixed = jnp.concatenate(
+            [a.reshape((g, gb) + tail), b.reshape((g, pb) + tail)], axis=1)
+        return mixed.reshape((R,) + tail)
+
+    return grouped(rows_g, rows_p), grouped(rows_gc, rows_pc)
 
 
 def rope_apply_with_prefix(

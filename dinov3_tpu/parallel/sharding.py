@@ -48,6 +48,11 @@ DEFAULT_LOGICAL_RULES = (
     # scan-over-blocks layer axis stays replicated (sharding it would be
     # FSDP-along-depth: an all-gather per use, not a pipeline).
     ("layers", None),
+    # crop packing: the mixed global+packed student row axis
+    # ([2B + P, N_g, D], ops/packing.py) splits over the same data axes
+    # as "batch" — see constrain_packed_rows below for why the row
+    # ORDER, not just the rule, is what keeps the pack shard-local.
+    ("packed_rows", ("dcn_data", "data", "fsdp")),
 )
 
 
@@ -92,6 +97,41 @@ def constrain_batch_dim(x: jax.Array, dim: int,
     spec = [None] * x.ndim
     spec[dim] = ("dcn_data", "data", "fsdp")
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def packed_row_groups(mesh: Mesh | None = None) -> int:
+    """Data-shard count for the crop-packed row layout (ops/packing.py).
+
+    The packed student batch interleaves global and packed rows in
+    data-shard-sized groups ([shard0 globals, shard0 packed, shard1
+    globals, ...]) so that the even GSPMD split of the concatenated row
+    axis coincides with a shard-local concatenation — each shard packs
+    ITS OWN local crops and never moves rows at the pack boundary. A
+    plain [globals..., packed...] order under the same even split would
+    put ~half of every shard's rows on other shards and force a
+    resharding all-to-all of the full token tensor per step direction.
+    ``make_packed_layout`` degrades to 1 (plain order) when the row
+    counts don't divide by this.
+    """
+    if mesh is None:
+        from dinov3_tpu.parallel.context import get_current_mesh
+
+        mesh = get_current_mesh()
+    if mesh is None:
+        return 1
+    from dinov3_tpu.parallel.mesh import data_parallel_size
+
+    return max(1, int(data_parallel_size(mesh)))
+
+
+def constrain_packed_rows(x: jax.Array,
+                          mesh: Mesh | None = None) -> jax.Array:
+    """Pin the packed student row axis (dim 0 of [2B+P, N_g, D]) onto
+    the data axes — the "packed_rows" logical rule. Combined with the
+    shard-grouped row order (``packed_row_groups``), the pack/unpack
+    reshapes stay shard-local under GSPMD. No-op without a mesh or when
+    the row count does not divide (constrain_batch_dim's convention)."""
+    return constrain_batch_dim(x, 0, mesh)
 
 
 def batch_specs(mesh: Mesh, batch: dict) -> dict:

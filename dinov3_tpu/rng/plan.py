@@ -191,6 +191,42 @@ def build_step_plan(step_key: jax.Array, specs: dict[str, PassPlanSpec],
     }
 
 
+# fold_in tag for the packed pass's drop-path lane: independent of the
+# split() lanes build_step_plan hands the global/local specs, so adding
+# the packed lane does NOT perturb their draws — the packed engine's
+# RoPE factors stay bitwise-identical to the two-pass oracle's
+_PACKED_LANE_TAG = 0x9ACC
+
+
+def packed_pass_plan(step_key: jax.Array, spec: PassPlanSpec,
+                     pass_plans: dict, mesh=None) -> dict:
+    """Randomness plan for the crop-packed single-pass student forward.
+
+    ``spec``: the packed pass's spec with ``batch = 2B + P`` (the mixed
+    global+packed row count) — drop-path subsetting operates at packed-
+    ROW granularity there: a dropped global row is one crop (the oracle's
+    granularity), a dropped packed row is its k local crops together.
+    Marginal per-crop drop rate is preserved; intra-row drops are
+    correlated (documented coarsening, docs/PERFORMANCE.md) — the price
+    of keeping the subset compute skip on the packed layout.
+
+    ``pass_plans``: the step plan's {"global": ..., "local": ...} lanes;
+    their per-pass RoPE factors are REUSED (not redrawn), nested as
+    {"rope": {"global": ..., "local": ...}} for the packed table builder
+    (models/vision_transformer.py _packed_rope) — bitwise the factors
+    the two-pass oracle consumes.
+    """
+    rope_spec = dataclasses.replace(
+        spec, rope_shift=None, rope_jitter=None, rope_rescale=None)
+    plan = build_pass_plan(
+        jax.random.fold_in(step_key, _PACKED_LANE_TAG), rope_spec, mesh)
+    rope = {name: p["rope"] for name, p in pass_plans.items()
+            if "rope" in p}
+    if rope:
+        plan["rope"] = rope
+    return plan
+
+
 def plan_layer_slice(plan: dict | None, i) -> dict | None:
     """Static per-layer slice of a pass plan's stacked drop-path arrays
     (the unrolled-stack consumer; the scanned stack slices via scan

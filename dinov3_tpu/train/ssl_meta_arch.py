@@ -184,6 +184,27 @@ class SSLMetaArch:
                 f"(parallel.pipe={pipe}); falling back to the legacy "
                 "fold_in rng path for this run")
             self.rng_plan = False
+        # Crop-packed single-pass student engine (ops/packing.py +
+        # models/vision_transformer.py _packed_forward): pack the local
+        # crop sequences k-per-row into global-length rows and run ONE
+        # backbone apply for global+local — one block scan, the weight
+        # stack streamed once per direction instead of twice, ~44
+        # well-tiled rows instead of 120 at ViT-L B=12. "auto"/true =
+        # packed (default); false = the two-pass oracle (the test
+        # reference; tests/test_crop_packing.py pins equivalence).
+        model_cfg = cfg.get("model") or {}
+        cp = model_cfg.get("crop_packing", "auto")
+        if isinstance(cp, str):
+            low = cp.lower()
+            if low not in ("auto", "true", "false", "on", "off"):
+                raise ValueError(
+                    f"model.crop_packing must be auto/true/false, "
+                    f"got {cp!r}")
+            self.crop_packing = low in ("auto", "true", "on")
+        else:
+            self.crop_packing = bool(cp)
+        if self.crop_packing:
+            self.crop_packing = self._resolve_crop_packing(cfg, pipe)
         self.gram_enabled = bool(cfg.gram.use_loss)
         self.gram_uses_ema_teacher = bool(cfg.gram.ema_teacher)
         # per-iteration loss-weight ramps (host numpy; moved in-graph by the
@@ -210,6 +231,42 @@ class SSLMetaArch:
                 warmup_iterations=int(s.get("warmup_epochs", 0) * L),
                 total_iterations=L * cfg.optim.epochs,
             )
+
+    def _resolve_crop_packing(self, cfg: ConfigNode, pipe: int) -> bool:
+        """Auto-fallback gate for the crop-packed engine (the pipeline/
+        convnext convention the rng plan established): returns whether
+        packing stays on, warning on every loud fallback."""
+        import warnings
+
+        if str(cfg.student.arch).startswith("convnext"):
+            # packing is a token-sequence layout; ConvNeXt has no token
+            # stack to pack (silent structural fallback, like rng.plan)
+            return False
+        if pipe > 1:
+            warnings.warn(
+                "model.crop_packing is not supported under pipeline "
+                f"parallelism (parallel.pipe={pipe}); falling back to "
+                "the two-pass student forward for this run")
+            return False
+        seq = int((cfg.get("parallel") or {}).get("seq", 1) or 1)
+        if seq > 1:
+            warnings.warn(
+                "model.crop_packing is not supported under sequence "
+                f"parallelism (parallel.seq={seq}: ring attention has "
+                "no segment masking); falling back to the two-pass "
+                "student forward for this run")
+            return False
+        from dinov3_tpu.ops.packing import layout_from_cfg
+
+        layout = layout_from_cfg(cfg, int(cfg.train.batch_size_per_device))
+        if layout is None or layout.k < 2:
+            k = None if layout is None else layout.k
+            warnings.warn(
+                "model.crop_packing: local sequences do not pack into "
+                f"global rows (k={k}; need >= 2 per row); falling back "
+                "to the two-pass student forward for this run")
+            return False
+        return True
 
     # ---------------- init ----------------
 
@@ -280,22 +337,62 @@ class SSLMetaArch:
         step — the arrays are born sharded along the batch axis
         (parallel/sharding.constrain_batch_dim).
         """
-        from dinov3_tpu.parallel.context import get_current_mesh
-        from dinov3_tpu.rng.plan import build_step_plan, spec_from_module
+        import dataclasses
 
+        from dinov3_tpu.parallel.context import get_current_mesh
+        from dinov3_tpu.rng.plan import (
+            build_step_plan,
+            packed_pass_plan,
+            spec_from_module,
+        )
+
+        mesh = get_current_mesh()
         specs = {
             "global": spec_from_module(
                 self.student_backbone, batch["global_crops"].shape[0]),
             "local": spec_from_module(
                 self.student_backbone, batch["local_crops"].shape[0]),
         }
-        return build_step_plan(rng, specs, get_current_mesh())
+        if not self.crop_packing:
+            return build_step_plan(rng, specs, mesh)
+        # packed engine: the global/local lanes keep their key positions
+        # (so the RoPE factors are bitwise the two-pass oracle's) but
+        # skip the drop-path draws the packed pass never consumes; the
+        # packed drop-path lane is drawn at packed-row granularity over
+        # 2B + P mixed rows from its own fold
+        plan = build_step_plan(
+            rng,
+            {k: dataclasses.replace(s, drop_path_rate=0.0)
+             for k, s in specs.items()},
+            mesh,
+        )
+        rows = self._packed_layout(batch).rows_total
+        plan["packed"] = packed_pass_plan(
+            rng, spec_from_module(self.student_backbone, rows), plan, mesh)
+        return plan
+
+    def _packed_layout(self, batch):
+        """The packed row layout for this batch's shapes (static)."""
+        from dinov3_tpu.ops.packing import make_packed_layout
+
+        p = self.cfg.student.patch_size
+        n_prefix = 1 + int(self.cfg.student.get("n_storage_tokens", 0) or 0)
+        g, l = batch["global_crops"], batch["local_crops"]
+        return make_packed_layout(
+            n_global_rows=g.shape[0], n_local=l.shape[0],
+            seq_global=n_prefix + (g.shape[1] // p) * (g.shape[2] // p),
+            seq_local=n_prefix + (l.shape[1] // p) * (l.shape[2] // p),
+            n_prefix=n_prefix,
+        )
 
     def _apply_backbone(self, module, params, x, masks=None, *, crop_kind,
-                        train, rngs=None, rng_plan=None):
+                        train, rngs=None, rng_plan=None, local_crops=None):
         # rng_plan is a ViT-only kwarg (ConvNeXt backbones keep the
-        # legacy rng path — meta init never enables the plan for them)
+        # legacy rng path — meta init never enables the plan for them);
+        # local_crops likewise (the crop-packed single-pass engine)
         plan_kw = {} if rng_plan is None else {"rng_plan": rng_plan}
+        if local_crops is not None:
+            plan_kw["local_crops"] = local_crops
         if train and getattr(module, "ffn_layer", "") == "moe":
             # MoE blocks sow their Switch-style load-balance terms into the
             # "losses" collection; collect them for compute_losses
@@ -451,7 +548,26 @@ class SSLMetaArch:
         n_g, n_l = 2, self.n_local_crops
         B = g.shape[0] // n_g
         masks = None if self.cfg.distillation.enabled else batch["masks"]
-        if rng_plan is not None:
+        moe_aux = None
+        if self.crop_packing:
+            # crop-packed single-pass engine: ONE backbone apply over
+            # [2B + P, N_g] rows (globals + k-packed locals) under
+            # segment-masked attention — the weight stack streams once
+            # per direction instead of twice (ops/packing.py; oracle =
+            # the two-pass branch below, model.crop_packing=false)
+            out = self._apply_backbone(
+                self.student_backbone, student_params["backbone"], g, masks,
+                crop_kind="global", train=True, rngs=rngs,
+                rng_plan=None if rng_plan is None else rng_plan["packed"],
+                local_crops=l,
+            )
+            g_cls, g_patch = out["x_norm_clstoken"], out["x_norm_patchtokens"]
+            l_cls = out["local_cls"]
+            if "moe_aux_loss" in out:
+                # one pass covers every token (the oracle averages its
+                # two per-pass load-balance terms)
+                moe_aux = out["moe_aux_loss"]
+        elif rng_plan is not None:
             # plan path: each pass consumes its own precomputed lane —
             # no per-pass fold_in, no make_rng anywhere in the forward
             g_out = self._apply_backbone(
@@ -472,8 +588,13 @@ class SSLMetaArch:
                 crop_kind="local", train=True,
                 rngs={k: jax.random.fold_in(v, 1) for k, v in rngs.items()},
             )
-        g_cls, g_patch = g_out["x_norm_clstoken"], g_out["x_norm_patchtokens"]
-        l_cls = l_out["x_norm_clstoken"]
+        if not self.crop_packing:
+            g_cls, g_patch = (g_out["x_norm_clstoken"],
+                              g_out["x_norm_patchtokens"])
+            l_cls = l_out["x_norm_clstoken"]
+            if "moe_aux_loss" in g_out or "moe_aux_loss" in l_out:
+                moe_aux = (g_out.get("moe_aux_loss", 0.0)
+                           + l_out.get("moe_aux_loss", 0.0)) / 2.0
 
         masked = self._gather_masked(g_patch, batch["mask_indices"])
         M = masked.shape[1]
@@ -496,10 +617,8 @@ class SSLMetaArch:
             "cls_after_head": g_logits,
             "masked_patch_after_head": masked_logits.reshape(2 * B, M, -1),
         }
-        if "moe_aux_loss" in g_out or "moe_aux_loss" in l_out:
-            global_out["moe_aux_loss"] = (
-                g_out.get("moe_aux_loss", 0.0) + l_out.get("moe_aux_loss", 0.0)
-            ) / 2.0
+        if moe_aux is not None:
+            global_out["moe_aux_loss"] = moe_aux
         local_out = {
             "cls_pre_head": l_cls.reshape(n_l, B, -1),
             "cls_after_head": l_logits,

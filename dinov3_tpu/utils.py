@@ -250,13 +250,21 @@ def _hlo_result_shape(line: str):
 def classify_copy(line: str) -> str:
     """Attribution category for one copy-class HLO instruction.
 
-    - "rng": u32 results of <= 8 elements — threefry key/counter
-      plumbing (keys are u32[2]/u32[4]; fold_in intermediates scalar).
     - "donation_async": ``copy-start``/``copy-done`` pairs — the async
       copies the runtime schedules around donated/aliased buffers and
       cross-memory DMA. (Heuristic by op kind: plain ``copy`` of a
       donated input exists too but is indistinguishable from a layout
       copy in HLO text.)
+    - "gather_pack": copies whose op_name metadata places them inside
+      the crop-packed engine's pack/unpack assembly (the
+      ``crop_pack``/``crop_unpack`` named scopes in
+      models/vision_transformer.py _packed_forward, and their
+      transposed backward ops, which inherit the scope) — the
+      pad/reshape/concat/slice traffic the packing engine introduces,
+      attributed so the census ceiling names it instead of silently
+      absorbing it.
+    - "rng": u32 results of <= 8 elements — threefry key/counter
+      plumbing (keys are u32[2]/u32[4]; fold_in intermediates scalar).
     - "small": any other result of <= 1024 elements (scalar metrics,
       index vectors, centers).
     - "large": activation/weight-shaped copies (> 1024 elements) — a
@@ -264,6 +272,8 @@ def classify_copy(line: str) -> str:
     """
     if "copy-start" in line or "copy-done" in line:
         return "donation_async"
+    if "crop_pack" in line or "crop_unpack" in line:
+        return "gather_pack"
     shp = _hlo_result_shape(line)
     if shp is None:
         return "small"

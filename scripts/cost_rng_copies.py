@@ -51,8 +51,15 @@ _spec.loader.exec_module(ctp)
 # the census arch (cost_target_phase.py convention): the copy structure
 # under audit — per-layer rng threading, donation aliasing, crop-concat
 # copies — is depth/width-independent at this granularity, and vit_test
-# keeps the CPU compile seconds-long
+# keeps the CPU compile seconds-long.
+# model.crop_packing is pinned OFF: this artifact (COST_RNG_r08.json)
+# is the rng-plan engine's before/after on the two-pass program it was
+# committed against; the PR-4 crop-packed engine independently removes
+# the two-pass crop-boundary copies from both arms (518 -> 190 legacy /
+# 144 -> 96 plan on the packed default, tests/test_streaming_targets.py
+# re-pins that ceiling) and would blur the attribution here.
 CENSUS_OVERRIDES = [
+    "model.crop_packing=false",
     "student.arch=vit_test", "student.patch_size=4",
     "crops.global_crops_size=16", "crops.local_crops_size=8",
     "crops.local_crops_number=2",

@@ -28,30 +28,44 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # (arch, batch, res_override_px_or_0, drop_path_mode, extra overrides)
+#
+# The pre-PR-4 points pin ``model.crop_packing=false``: they were
+# committed against the two-pass student program (FLOPS_r04/r05) and
+# serve as the stable cross-check rungs; the crop-packed default
+# program (one backbone scan, pad tokens priced in) gets its own
+# standing ledger point so the pad-waste FLOPs sit next to the subset
+# drop-path cut in the artifact.
+_TWO_PASS = "model.crop_packing=false"
 POINTS = {
     # the r4 pair, reproduced: the subset drop-path FLOP cut on the
     # default bench program (ViT-L/16, B=8, 224px + 8x96px)
-    "vitl_mask": ("vit_large", 8, 0, "mask", []),
-    "vitl_subset": ("vit_large", 8, 0, "subset", []),
+    "vitl_mask": ("vit_large", 8, 0, "mask", [_TWO_PASS]),
+    "vitl_subset": ("vit_large", 8, 0, "subset", [_TWO_PASS]),
     # the r5 default program: B=12, the on-chip sweep peak
     # (58.56 img/s/chip, MEASUREMENTS_r5.md phC row)
-    "vitl_subset_b12": ("vit_large", 12, 0, "subset", []),
+    "vitl_subset_b12": ("vit_large", 12, 0, "subset", [_TWO_PASS]),
+    # the PR-4 default program: crop-packed single-pass student (44
+    # packed rows instead of 120; attention runs over 197-token rows
+    # for the locals too, so the pad/cross-segment waste shows up HERE
+    # as extra counted FLOPs — the engine trades them for one weight
+    # stream and clean tiling, COST_PACK_r09.json)
+    "vitl_packed_b12": ("vit_large", 12, 0, "subset", []),
     # ladder points for the fp32-master BENCH_ARCH rungs (phH); the
     # _mask variants exist because the r1 bf16-master measurements ran
     # the mask program — utilization comparisons must divide them by
     # mask-program ceilings, not subset ones
-    "vits": ("vit_small", 32, 0, "subset", []),
-    "vits_mask": ("vit_small", 32, 0, "mask", []),
-    "vitb": ("vit_base", 16, 0, "subset", []),
-    "vitb_mask": ("vit_base", 16, 0, "mask", []),
+    "vits": ("vit_small", 32, 0, "subset", [_TWO_PASS]),
+    "vits_mask": ("vit_small", 32, 0, "mask", [_TWO_PASS]),
+    "vitb": ("vit_base", 16, 0, "subset", [_TWO_PASS]),
+    "vitb_mask": ("vit_base", 16, 0, "mask", [_TWO_PASS]),
     # high-res points (SLOW: the unrolled 512px host compile is ~4.5 min,
     # 768px substantially more) — request explicitly via FLOPS_POINTS
     "hr512": ("vit_large", 2, 512, "subset",
-              ["kernels.flash_attention=xla"]),
+              ["kernels.flash_attention=xla", _TWO_PASS]),
     # B=2, not 1: KoLeo requires >=2 samples per group — a B=1 program
     # fails at build (this is also why the r5 queue's phF_hr768 is B=2)
     "hr768": ("vit_large", 2, 768, "subset",
-              ["kernels.flash_attention=xla"]),
+              ["kernels.flash_attention=xla", _TWO_PASS]),
 }
 
 
@@ -121,8 +135,11 @@ def main():
         "script": "scripts/count_flops.py",
         "date": time.strftime("%Y-%m-%d"),
         "cross_check": ("vitl_mask/vitl_subset/hr512 must reproduce "
-                        "FLOPS_r04.json (13.680/10.083/9.344) — any "
-                        "drift means the bench program changed"),
+                        "FLOPS_r04.json (13.680/10.083/9.344) — they pin "
+                        "model.crop_packing=false, so any drift means "
+                        "the two-pass program itself changed; the "
+                        "crop-packed default program is the separate "
+                        "vitl_packed_b12 point"),
         "points": {},
     }
     # incremental: each point is written as soon as it is counted, so a

@@ -31,6 +31,18 @@
 #         step (518 -> 144; the removed ops are the u32 RNG-key
 #         plumbing, per-category attribution in the artifact); this
 #         measures what the TPU scheduler does with each form.
+#   phP   crop-packed single-pass student engine A/B (the two-pass
+#         weight stream + 37-token tiling attack, ops/packing.py):
+#         default program (model.crop_packing auto=on) vs
+#         model.crop_packing=false two-pass control, same session,
+#         both arms pinned BENCH_PROBS=bf16 AND BENCH_CENSUS=1 (the
+#         r5b phT lesson: unpinned arms measured different programs).
+#         Host-side accounting (scripts/cost_pack_student.py,
+#         COST_PACK_r09.json): -50% student-phase weight-stream bytes
+#         (4 -> 2 ViT-L stack streams per step), 120 -> 44 rows at
+#         B=12; the packed attention's extra score bytes are the
+#         documented trade — this measures which side the TPU
+#         scheduler lands on.
 #   phG2  fixed op-level flash-vs-dense attention crossover
 #         (scripts/crossover_attention.py): the
 #         kernels.flash_min_seq=2048 boundary is measured only at
@@ -158,6 +170,15 @@ run_bench phS_sc_stream_off_ctl 2100 pinned BENCH_PROBS=bf16 \
 run_bench phR_rngplan_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1
 run_bench phR_rngplan_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=rng.plan=false
+
+# phP: crop-packed student engine A/B. Treatment = the committed
+# default program (model.crop_packing auto = on); control strips ONLY
+# the engine (two-pass student forward). Both arms carry the compiled
+# copy census so the pack/unpack attribution (utils.classify_copy
+# "gather_pack") lands next to the throughput delta.
+run_bench phP_packed_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1
+run_bench phP_packed_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=model.crop_packing=false
 
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including
