@@ -54,11 +54,14 @@ B=10, MEASUREMENTS_r5.md phC rows — the committed BENCH_r05_phases.jsonl
 holds only phA/phB; the old B=8 default was the round-1
 bf16-master peak),
 BENCH_STEPS (10), BENCH_WARMUP (3), BENCH_RES (high-res crop px),
-BENCH_CENSUS=1 (or ``--census``; embed a copy census of the exact
-compiled step — counts/bytes/attribution, utils.hlo_copy_census — in
-the record, so copy regressions surface in the same JSONL artifact as
-throughput; use the env form under supervision, argv does not propagate
-to the measurement child).
+BENCH_CENSUS=1 (or ``--census``; embed a copy census AND a collective
+census of the exact compiled step — counts/bytes/attribution,
+utils.hlo_copy_census / utils.hlo_collective_census — in the record, so
+copy and collective regressions surface in the same JSONL artifact as
+throughput; the sharded-update A/B (r6_queue phZ) reads the
+all-reduce-vs-reduce-scatter grad-sync story straight from
+``collective_census.by_class``; use the env form under supervision,
+argv does not propagate to the measurement child).
 """
 
 from __future__ import annotations
@@ -607,7 +610,17 @@ def main():
     batch_np = make_synthetic_batch(cfg, B, seed=0)
     batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
 
-    setup = build_train_setup(cfg, batch)
+    # the sharded-update padding guardrail (configs/config.py
+    # warn_update_shard_padding) fires inside build_train_setup, where
+    # the param shapes first exist — capture it into the record like the
+    # tiling warnings above
+    import warnings as _bwarnings
+
+    with _bwarnings.catch_warnings(record=True) as _bcaught:
+        _bwarnings.simplefilter("always")
+        setup = build_train_setup(cfg, batch)
+    pad_warnings = [str(w.message) for w in _bcaught
+                    if "sharded-update flat master axis" in str(w.message)]
     dbatch = put_batch(batch, setup.batch_shardings)
     rng = jax.random.key(0)
     state = setup.state
@@ -628,20 +641,31 @@ def main():
     _log("compile done")
 
     census = None
+    coll_census = None
     if os.environ.get("BENCH_CENSUS") == "1" or "--census" in sys.argv:
-        # copy census of the EXACT program being benched (same compiled
-        # HLO, no recompile), so copy regressions surface in the same
-        # JSONL artifact as the throughput they cost — the attribution
-        # categories are utils.classify_copy's (rng / donation_async /
-        # small / large)
-        from dinov3_tpu.utils import hlo_copy_census
+        # copy + collective census of the EXACT program being benched
+        # (same compiled HLO, no recompile), so copy/collective
+        # regressions surface in the same JSONL artifact as the
+        # throughput they cost — attribution categories are
+        # utils.classify_copy's (rng / donation_async / update_shard /
+        # small / large) and utils.classify_collective's (all_reduce /
+        # reduce_scatter / all_gather / ppermute / all_to_all /
+        # unattributed; the sharded-update A/B reads the grad-sync story
+        # straight from by_class)
+        from dinov3_tpu.utils import hlo_collective_census, hlo_copy_census
 
         try:
-            census = hlo_copy_census(compiled.as_text())
+            hlo_text = compiled.as_text()
+            census = hlo_copy_census(hlo_text)
             _log(f"copy census: total={census['hlo_copy_total']} "
                  f"by_category={census['by_category']}")
+            coll_census = hlo_collective_census(hlo_text)
+            _log(f"collective census: "
+                 f"total={coll_census['hlo_collective_total']} "
+                 f"by_class={coll_census['by_class']}")
         except Exception as e:  # noqa: BLE001 - census must never kill a run
-            census = {"error": str(e)[:200]}
+            census = census or {"error": str(e)[:200]}
+            coll_census = coll_census or {"error": str(e)[:200]}
 
     steps = max(1, steps)
     _phase("warmup")
@@ -674,8 +698,12 @@ def main():
     }
     if census is not None:
         rec["copy_census"] = census
+    if coll_census is not None:
+        rec["collective_census"] = coll_census
     if tiling_warning:
         rec["batch_tiling_warning"] = tiling_warning
+    if pad_warnings:
+        rec["update_shard_padding_warning"] = "; ".join(pad_warnings)
     if degraded:
         # distinct reasons can fire for the global- and local-crop
         # batches of the same program — keep them all

@@ -22,6 +22,67 @@ from dinov3_tpu.train.train_step import TrainState
 logger = logging.getLogger("dinov3")
 
 
+def _adapt_opt_leaf(stored, like):
+    """One Adam-moment leaf: checkpoint layout -> ``state_like`` layout.
+
+    The sharded update engine (train/fused_update.py,
+    ``optim.sharded_update``) stores mu/nu as flat arrays zero-padded to
+    a multiple of the data-axis size; the replicated engines store them
+    param-shaped. Both directions are lossless: flat -> full drops the
+    (inert, exactly-zero) padding; full -> flat re-adds zeros. Returns a
+    numpy array in ``like``'s shape.
+    """
+    import numpy as np
+
+    v = np.asarray(stored)
+    if v.shape == tuple(like.shape):
+        return v
+    n_like = 1
+    for d in like.shape:
+        n_like *= int(d)
+    if v.ndim == 1 and v.size >= n_like:
+        # sharded checkpoint -> replicated/model layout
+        return v[:n_like].reshape(like.shape)
+    if len(like.shape) == 1 and v.size <= like.shape[0]:
+        # replicated checkpoint -> sharded flat layout
+        flat = v.reshape(-1)
+        return np.pad(flat, (0, int(like.shape[0]) - flat.size))
+    raise ValueError(
+        f"cannot adapt opt-state leaf of shape {v.shape} to {like.shape}"
+    )
+
+
+def _opt_moment_shapes(state_like):
+    """The mu leaf-shape list of ``state_like``'s opt state, or None when
+    the state does not carry the scheduled-adamw ``adam.mu`` subtree."""
+    adam = getattr(getattr(state_like, "opt_state", None), "adam", None)
+    mu = getattr(adam, "mu", None)
+    if mu is None:
+        return None
+    return [tuple(l.shape) for l in jax.tree.leaves(mu)]
+
+
+def _replace_opt_moments(state_abstract, stored_mu, stored_nu):
+    """Swap the abstract mu/nu subtrees for ones in the CHECKPOINT's
+    shapes (metadata leaves -> plain ShapeDtypeStructs, no sharding: the
+    stored layout has no placement in this run's mesh; orbax restores
+    them addressable and ``restore`` adapts + re-places them)."""
+    import numpy as np
+
+    def abs_leaf(m):
+        return jax.ShapeDtypeStruct(
+            tuple(m.shape), np.dtype(getattr(m, "dtype", np.float32))
+        )
+
+    adam = state_abstract.opt_state.adam._replace(
+        mu=jax.tree.map(abs_leaf, stored_mu),
+        nu=jax.tree.map(abs_leaf, stored_nu),
+    )
+    return state_abstract._replace(
+        opt_state=state_abstract.opt_state._replace(adam=adam)
+    )
+
+
 def pytree_restore_args(item, **kw):
     """``ocp.args.PyTreeRestore`` with partial restore across orbax
     versions: newer orbax spells it ``partial_restore=True``; older ones
@@ -40,8 +101,29 @@ def pytree_restore_args(item, **kw):
 
 def item_metadata_tree(manager, step: int, name: str = "state"):
     """Tree of a checkpoint item's metadata across orbax versions (newer
-    managers wrap it in an object with a ``.tree`` attribute)."""
+    managers wrap it in an object with a ``.tree`` attribute).
+
+    A manager that has not saved in THIS process has no handler
+    registered for ``name`` yet and reports the item's metadata as None
+    (resume flows hit this); fall back to a throwaway manager with an
+    explicit ``StandardCheckpointHandler`` registration, which resolves
+    metadata without touching the caller's manager or the checkpoint.
+    Returns None when no metadata can be resolved (ancient orbax)."""
     meta = manager.item_metadata(step)[name]
+    if meta is None:
+        try:
+            reader = ocp.CheckpointManager(
+                manager.directory,
+                item_handlers={name: ocp.StandardCheckpointHandler()},
+            )
+            try:
+                meta = reader.item_metadata(step)[name]
+            finally:
+                reader.close()
+        except (TypeError, AttributeError):
+            return None
+    if meta is None:
+        return None
     return meta.tree if hasattr(meta, "tree") else meta
 
 
@@ -161,6 +243,13 @@ class Checkpointer:
                     # records; the bytes are intact — reinterpret with the
                     # like-leaf's dtype
                     v = v.view(np.dtype(like.dtype))
+                if (".opt_state" in key
+                        and tuple(v.shape) != tuple(
+                            getattr(like, "shape", v.shape))):
+                    # sharded <-> replicated update-engine layouts
+                    # (_adapt_opt_leaf): flat padded moments round-trip
+                    # losslessly against param-shaped ones
+                    v = _adapt_opt_leaf(v, like)
                 if isinstance(like, jax.Array):
                     v = jax.device_put(v, like.sharding)
                 leaves.append(v)
@@ -195,6 +284,16 @@ class Checkpointer:
         ``state_like`` may be the freshly initialized (sharded) state: each
         leaf is restored directly to its ``NamedSharding`` placement, no
         host-side detour (multi-host safe).
+
+        Checkpoints cross update-engine arms in both directions: a
+        replicated-arm checkpoint (param-shaped adam moments) restores
+        into a sharded-update run (flat padded moments,
+        ``optim.sharded_update``) and vice versa — the moment leaves are
+        detected by shape against the stored metadata, restored in their
+        STORED layout, and adapted losslessly (``_adapt_opt_leaf``) onto
+        ``state_like``'s placement. The adapting path stages the moments
+        addressably before re-placing them, so it is a single-host
+        convenience; same-arm restores keep the direct sharded path.
         """
         step = step if step is not None else self.latest_step()
         if step is None:
@@ -204,12 +303,51 @@ class Checkpointer:
             logger.info("restored checkpoint at step %d (local npz)", step)
             return restored
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
+        adapt = False
+        like_shapes = _opt_moment_shapes(state_like)
+        if like_shapes is not None:
+            try:
+                meta = item_metadata_tree(self.manager, step)
+                stored_mu = meta["opt_state"]["adam"]["mu"]
+                stored_nu = meta["opt_state"]["adam"]["nu"]
+                stored_shapes = [tuple(l.shape)
+                                 for l in jax.tree.leaves(stored_mu)]
+            except (KeyError, TypeError, AttributeError):
+                # metadata unresolvable (ancient orbax): same-arm
+                # restores still work; a true cross-arm restore will
+                # fail loudly at shape-intersection time below
+                stored_shapes = like_shapes
+            if stored_shapes != like_shapes:
+                abstract = _replace_opt_moments(abstract, stored_mu, stored_nu)
+                adapt = True
         restored = self.manager.restore(
             step,
             args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)),
-        )
+        )["state"]
+        if adapt:
+            adam_like = state_like.opt_state.adam
+
+            def put(stored, like):
+                v = _adapt_opt_leaf(stored, like)
+                sharding = getattr(like, "sharding", None)
+                return (jax.device_put(v, sharding)
+                        if sharding is not None else jax.numpy.asarray(v))
+
+            adam = restored.opt_state.adam._replace(
+                mu=jax.tree.map(put, restored.opt_state.adam.mu,
+                                adam_like.mu),
+                nu=jax.tree.map(put, restored.opt_state.adam.nu,
+                                adam_like.nu),
+            )
+            restored = restored._replace(
+                opt_state=restored.opt_state._replace(adam=adam)
+            )
+            logger.info(
+                "restored checkpoint at step %d (opt-state layout adapted "
+                "across update-engine arms)", step)
+            return restored
         logger.info("restored checkpoint at step %d", step)
-        return restored["state"]
+        return restored
 
     def wait_until_finished(self) -> None:
         if self._local:

@@ -311,6 +311,52 @@ def warn_student_row_tiling(
     return msgs
 
 
+def update_shard_padding_waste(leaf_sizes, dp: int) -> float:
+    """Fraction of zero-padded lanes the sharded update engine carries.
+
+    The engine (train/fused_update.py make_sharded_update) flattens each
+    master/moment/teacher leaf and zero-pads it to a multiple of the
+    data-axis size ``dp``; padded lanes are inert but still cost HBM
+    traffic and storage on every replica's 1/dp shard. Per-leaf padding
+    is at most ``dp - 1`` elements, so the fraction only matters when a
+    model is dominated by tiny leaves or ``dp`` is very large. Returns
+    ``padded_extra / total`` (0.0 for an empty tree).
+    """
+    dp = max(1, int(dp))
+    total = extra = 0
+    for n in leaf_sizes:
+        n = int(n)
+        total += n
+        extra += (-n) % dp
+    return extra / total if total else 0.0
+
+
+def warn_update_shard_padding(
+    leaf_sizes, dp: int, threshold: float = 0.01, stacklevel: int = 2,
+) -> str | None:
+    """Warn when sharded-update zero-padding wastes > ``threshold`` of
+    the flattened master size at the chosen data-axis size — the
+    axis-labelled guardrail style of ``warn_bad_batch_tiling``. Fired at
+    training-setup build (train/setup.py, where the param shapes first
+    exist) and by ``bench.py`` (recorded in the bench JSON); returns the
+    message, or None when the padding is negligible."""
+    waste = update_shard_padding_waste(leaf_sizes, dp)
+    if waste <= threshold:
+        return None
+    msg = (
+        f"sharded-update flat master axis: zero-padding to the "
+        f"data-axis size dp={dp} wastes {waste:.1%} of the flattened "
+        f"master size (> {threshold:.0%}) — every replica streams that "
+        f"padding through its 1/dp update shard each step "
+        f"(train/fused_update.py). Use a smaller data-parallel axis for "
+        f"this model, or set optim.sharded_update=false."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
+
+
 def apply_scaling_rules_to_cfg(cfg: ConfigNode) -> ConfigNode:
     """Batch-size lr scaling, resolved once at load time.
 

@@ -27,11 +27,14 @@ from dinov3_tpu.parallel.ring_attention import (
 )
 from dinov3_tpu.parallel.sharding import (
     DEFAULT_LOGICAL_RULES,
+    UPDATE_SHARD_AXES,
     batch_sharding,
     batch_specs,
+    constrain_update_shard,
     make_sharded_init,
     replicated,
     state_shardings_from_abstract,
+    update_shard_size,
 )
 
 __all__ = [
@@ -49,9 +52,12 @@ __all__ = [
     "process_count",
     "process_index",
     "DEFAULT_LOGICAL_RULES",
+    "UPDATE_SHARD_AXES",
     "batch_sharding",
     "batch_specs",
+    "constrain_update_shard",
     "make_sharded_init",
     "replicated",
     "state_shardings_from_abstract",
+    "update_shard_size",
 ]

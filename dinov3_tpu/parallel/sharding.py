@@ -53,7 +53,21 @@ DEFAULT_LOGICAL_RULES = (
     # as "batch" — see constrain_packed_rows below for why the row
     # ORDER, not just the rule, is what keeps the pack shard-local.
     ("packed_rows", ("dcn_data", "data", "fsdp")),
+    # cross-replica sharded update (train/fused_update.py
+    # make_sharded_update): the flat padded axis of every optimizer-
+    # moment leaf splits over the SAME axes as "batch", so the
+    # reduce-scatter of grads and the all-gather of updated params
+    # lower onto the mesh axes the batch already rides — each data
+    # replica owns 1/dp of every master/moment/teacher leaf for the
+    # update phase (Xu et al. 2020's automatic cross-replica sharding,
+    # realized through GSPMD annotations instead of a manual pass).
+    ("update_shard", ("dcn_data", "data", "fsdp")),
 )
+
+# the mesh axes the sharded update engine splits over — one tuple shared
+# by the logical rule above, the in-graph constraint below, and the
+# setup-time axis-size product, so the three can never disagree
+UPDATE_SHARD_AXES = ("dcn_data", "data", "fsdp")
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -96,6 +110,43 @@ def constrain_batch_dim(x: jax.Array, dim: int,
         return x
     spec = [None] * x.ndim
     spec[dim] = ("dcn_data", "data", "fsdp")
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def update_shard_size(mesh: Mesh | None = None) -> int:
+    """Number of update shards = product of the data-parallel axis sizes
+    (``UPDATE_SHARD_AXES``). 1 without a mesh — the replicated engine."""
+    if mesh is None:
+        from dinov3_tpu.parallel.context import get_current_mesh
+
+        mesh = get_current_mesh()
+    if mesh is None:
+        return 1
+    dp = 1
+    for a in UPDATE_SHARD_AXES:
+        dp *= int(mesh.shape.get(a, 1))
+    return max(1, dp)
+
+
+def constrain_update_shard(x: jax.Array,
+                           mesh: Mesh | None = None) -> jax.Array:
+    """Pin a flat padded update-phase leaf (1-D, size divisible by
+    ``update_shard_size``) onto the data axes — the "update_shard"
+    logical rule. The sharded update engine routes every flattened
+    grad/master/moment/teacher leaf through this, so the grad
+    reduce-scatter and the param all-gather lower onto the same mesh
+    axes as "batch". No-op without a mesh (replicated test shapes)."""
+    if mesh is None:
+        from dinov3_tpu.parallel.context import get_current_mesh
+
+        mesh = get_current_mesh()
+    if mesh is None:
+        return x
+    dp = update_shard_size(mesh)
+    if dp <= 1 or x.shape[0] % dp != 0:
+        return x
+    spec = [None] * x.ndim
+    spec[0] = tuple(a for a in UPDATE_SHARD_AXES if a in mesh.shape)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 

@@ -1,6 +1,9 @@
 from dinov3_tpu.train.fused_update import (
     build_fused_update,
+    build_sharded_update,
     make_fused_update,
+    make_sharded_update,
+    make_sharded_update_schedule,
 )
 from dinov3_tpu.train.optimizer import (
     build_optimizer,
@@ -21,6 +24,8 @@ from dinov3_tpu.train.train_step import TrainState, make_train_step
 
 __all__ = [
     "build_fused_update", "make_fused_update",
+    "build_sharded_update", "make_sharded_update",
+    "make_sharded_update_schedule",
     "build_optimizer", "clip_by_per_submodel_norm", "per_submodel_norms",
     "scheduled_adamw",
     "build_multiplier_trees", "Schedules", "build_schedules",

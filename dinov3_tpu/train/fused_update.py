@@ -1,4 +1,5 @@
-"""Single-pass fused clip + AdamW + teacher-EMA update engine.
+"""Single-pass fused clip + AdamW + teacher-EMA update engine, and its
+cross-replica sharded form.
 
 The r5 on-chip profile (``PROFILE_r05.json``, docs/PERFORMANCE.md) puts
 28.5% of the ViT-L step in norm/reduce fusions whose largest named
@@ -35,6 +36,43 @@ chain's ``ScheduledAdamWState`` pytree unchanged: checkpoints, sharding
 derivation (train/setup.py eval_shape) and buffer donation are
 identical on both paths. Toggle with ``optim.fused_update`` (default
 on); the bench A/B rung is armed in scripts/r6_queue.sh.
+
+Cross-replica SHARDED update (``make_sharded_update``, toggled by
+``optim.sharded_update``, auto = on when the data-parallel axis product
+is > 1): every replica of the fused engine above still runs the full
+single-pass update over the complete fp32 master/moment/teacher trees —
+dp-way redundant compute and HBM traffic on exactly the weight-shaped
+~12 ms/step floor. Following "Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training" (Xu et al., 2020), the sharded
+engine reshapes the update phase into
+
+    reduce-scatter(grads) -> per-shard clip+AdamW+EMA over 1/dp of
+    every leaf -> all-gather(updated student + EMA'd teacher)
+
+realized through GSPMD sharding annotations (parallel/sharding.py
+"update_shard" rule, the same mesh axes "batch" rides) instead of a
+manual collective pass: each leaf is flattened, zero-padded to a
+multiple of dp (padded lanes are inert — g=p=mu=nu=0 stays 0 through
+the update math), and pinned shard-wise with
+``constrain_update_shard``; the optimizer moments are BORN in that flat
+sharded layout (``sharded_adam_zeros``, train/setup.py), so each
+replica stores 1/dp of mu/nu (ZeRO-1) and the update's elementwise
+traffic drops by the same factor. The per-submodel clip norms come out
+as shard-local partial sums + one small psum (the same
+``per_submodel_norms`` graph, now over the flat sharded leaves), so
+clipping matches the replicated oracle up to reduction associativity.
+The jit-level out_shardings re-materialize the updated student/teacher
+in their model layout — the all-gather. On this container's XLA:CPU the
+grad sync lowers structurally as all-reduce + fused dynamic-slice (the
+pre-rewrite form); TPU/GPU XLA's collective optimizer rewrites that
+pair into the reduce-scatter the annotations describe —
+``make_sharded_update_schedule`` below is the same schedule written
+with explicit collectives (shard_map + psum_scatter/all_gather), used
+by scripts/cost_sharded_update.py so the committed census shows the
+post-rewrite collective set on any backend. The replicated fused engine
+stays the test oracle behind ``optim.sharded_update=false``
+(leaf-for-leaf equivalence pinned in tests/test_sharded_update.py);
+the on-chip A/B is armed as scripts/r6_queue.sh phZ.
 """
 
 from __future__ import annotations
@@ -78,6 +116,37 @@ def _safe_int32_increment(count: jnp.ndarray) -> jnp.ndarray:
     max_int32 = jnp.iinfo(jnp.int32).max
     one = jnp.array(1, jnp.int32)
     return jnp.where(count < max_int32, count + one, max_int32)
+
+
+def update_leaf_math(g, p, mu, nu, t, lm, wm, is_ll, scale,
+                     lr_t, ll_lr_t, wd_t, bc1, bc2, b1, b2, eps,
+                     momentum, ema):
+    """The single-pass clip+AdamW+EMA per-leaf rule.
+
+    Single source of truth for the update math: the replicated fused
+    engine, the cross-replica sharded engine, and the explicit-collective
+    schedule program all call this exact function (on full leaves, flat
+    1/dp shards, and shard_map-local shards respectively), so the three
+    step programs cannot drift apart. Returns ``(new_param, new_mu,
+    new_nu[, new_teacher])``.
+    """
+    if scale is not _NO_CLIP:
+        g = (g * scale).astype(g.dtype)
+    # scale_by_adam's moment updates + bias correction, verbatim
+    mu_n = (1 - b1) * g + b1 * mu
+    nu_n = (1 - b2) * (g ** 2) + b2 * nu
+    mu_hat = mu_n / bc1.astype(mu_n.dtype)
+    nu_hat = nu_n / bc2.astype(nu_n.dtype)
+    direction = mu_hat / (jnp.sqrt(nu_hat) + eps)
+    # scheduled_adamw's per-leaf rule, verbatim
+    lr = jnp.where(is_ll, ll_lr_t, lr_t)
+    d = direction + wd_t * wm * p.astype(direction.dtype)
+    upd = -lr * lm * d
+    # optax.apply_updates' cast, verbatim
+    p_n = jnp.asarray(p + upd).astype(p.dtype)
+    if ema:
+        return p_n, mu_n, nu_n, ema_leaf(t, p_n, momentum)
+    return p_n, mu_n, nu_n
 
 
 def make_fused_update(
@@ -139,23 +208,10 @@ def make_fused_update(
             scale_tree = jax.tree.map(lambda _: _NO_CLIP, grads)
 
         def leaf(g, p, mu, nu, t, lm, wm, is_ll, scale):
-            if scale is not _NO_CLIP:
-                g = (g * scale).astype(g.dtype)
-            # scale_by_adam's moment updates + bias correction, verbatim
-            mu_n = (1 - b1) * g + b1 * mu
-            nu_n = (1 - b2) * (g ** 2) + b2 * nu
-            mu_hat = mu_n / bc1.astype(mu_n.dtype)
-            nu_hat = nu_n / bc2.astype(nu_n.dtype)
-            direction = mu_hat / (jnp.sqrt(nu_hat) + eps)
-            # scheduled_adamw's per-leaf rule, verbatim
-            lr = jnp.where(is_ll, ll_lr_t, lr_t)
-            d = direction + wd_t * wm * p.astype(direction.dtype)
-            upd = -lr * lm * d
-            # optax.apply_updates' cast, verbatim
-            p_n = jnp.asarray(p + upd).astype(p.dtype)
-            if ema:
-                return p_n, mu_n, nu_n, ema_leaf(t, p_n, momentum)
-            return p_n, mu_n, nu_n
+            return update_leaf_math(
+                g, p, mu, nu, t, lm, wm, is_ll, scale,
+                lr_t, ll_lr_t, wd_t, bc1, bc2, b1, b2, eps, momentum, ema,
+            )
 
         n_out = 4 if ema else 3
         teacher_arg = teacher if ema else jax.tree.map(lambda _: 0.0, grads)
@@ -210,3 +266,394 @@ def build_fused_update(
         b1=cfg.optim.adamw_beta1, b2=cfg.optim.adamw_beta2,
         clip_grad=cfg.optim.clip_grad, ema=ema,
     )
+
+
+# ---------------- cross-replica sharded update engine ----------------
+
+def padded_flat_size(n: int, dp: int) -> int:
+    """Flat leaf size padded up to a multiple of the shard count."""
+    return -(-int(n) // dp) * dp
+
+
+def leaf_size(x) -> int:
+    """Element count of a (possibly abstract) leaf."""
+    n = 1
+    for d in x.shape:
+        n *= int(d)
+    return n
+
+
+def flatten_update_leaf(x, dp: int):
+    """Leaf -> flat 1-D array zero-padded to a multiple of ``dp``.
+
+    The zero padding is inert through ``update_leaf_math``: a padded
+    lane has g = p = mu = nu = teacher = 0, so mu_n = nu_n = 0, the
+    direction is 0/(sqrt(0)+eps) = 0, weight decay contributes
+    wd*wm*0 = 0, and the lane stays exactly 0 forever — flatten/
+    unflatten round-trips are lossless (pinned in
+    tests/test_sharded_update.py).
+    """
+    flat = x.reshape(-1)
+    pad = (-flat.size) % dp
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat
+
+
+def unflatten_update_leaf(flat, like):
+    """Flat padded array -> the original leaf shape (drop the padding)."""
+    return flat[: leaf_size(like)].reshape(like.shape)
+
+
+def sharded_adam_zeros(student_abstract: Any, dp: int) -> Any:
+    """Flat sharded-layout Adam moment zeros, boxed for sharding
+    derivation.
+
+    Mirrors ``optax.scale_by_adam``'s ``zeros_like`` init but in the
+    sharded engine's storage layout: one flat [padded] leaf per param
+    (padded_flat_size), boxed with the "update_shard" LOGICAL axis (the
+    same ``with_logical_partitioning`` box class the model params use,
+    so unboxing under a mesh context resolves through the logical rules
+    instead of demanding a literal mesh axis) —
+    ``state_shardings_from_abstract`` then lays each replica's 1/dp
+    slice onto the data axes. Used by train/setup.py's boxed init;
+    ``student_abstract`` is the *unboxed* student param tree (abstract
+    or concrete — only shapes/dtypes are read).
+    """
+    import flax.linen as nn
+
+    def z(p):
+        init = nn.with_logical_partitioning(
+            lambda: jnp.zeros((padded_flat_size(leaf_size(p), dp),),
+                              p.dtype),
+            ("update_shard",),
+        )
+        return init()
+
+    return jax.tree.map(z, student_abstract)
+
+
+def _check_sharded_opt_state(opt_state, grads, dp: int) -> None:
+    if not isinstance(opt_state, ScheduledAdamWState):
+        raise TypeError(
+            "sharded update engine requires the scheduled_adamw state, "
+            f"got {type(opt_state).__name__}"
+        )
+    g0 = jax.tree.leaves(grads)[0]
+    mu0 = jax.tree.leaves(opt_state.adam.mu)[0]
+    want = padded_flat_size(leaf_size(g0), dp)
+    if mu0.ndim != 1 or mu0.shape[0] != want:
+        raise TypeError(
+            "sharded update engine requires the flat sharded opt state "
+            f"(mu leaf {mu0.shape}, expected ({want},) at dp={dp}); init "
+            "via build_train_setup with optim.sharded_update on, or "
+            "restore through Checkpointer (which adapts replicated "
+            "checkpoints to the sharded layout)"
+        )
+
+
+def make_sharded_update(
+    schedules: Schedules,
+    lr_mult: Any,
+    wd_mult: Any,
+    is_last_layer: Any,
+    mesh: Any,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    clip_grad: float | None = None,
+    ema: bool = True,
+) -> Callable:
+    """Build the cross-replica sharded engine (module docstring).
+
+    Same contract as ``make_fused_update`` — ``update(grads, params,
+    teacher, opt_state, momentum) -> (new_params, new_teacher,
+    new_opt_state, norms)`` — except ``opt_state.adam.mu/nu`` leaves are
+    flat [padded] arrays in the "update_shard" layout
+    (``sharded_adam_zeros``). Params/teacher enter and leave in their
+    model layout; their shard-layout forms live only inside the step.
+    """
+    from dinov3_tpu.parallel.sharding import (
+        constrain_update_shard,
+        update_shard_size,
+    )
+
+    dp = update_shard_size(mesh)
+    lr_arr = jnp.asarray(schedules.lr, jnp.float32)
+    ll_lr_arr = jnp.asarray(schedules.last_layer_lr, jnp.float32)
+    wd_arr = jnp.asarray(schedules.weight_decay, jnp.float32)
+    do_clip = clip_grad is not None and clip_grad > 0
+
+    def to_shard(x):
+        with jax.named_scope("update_shard_pack"):
+            return constrain_update_shard(flatten_update_leaf(x, dp), mesh)
+
+    def mult_to_shard(m, like):
+        # scalar multipliers ride along unchanged; scanned-stack [L,1,..]
+        # multiplier arrays are materialized per element before the leaf
+        # shape is flattened away (XLA fuses the broadcast into the
+        # update kernel)
+        if getattr(m, "ndim", 0) == 0:
+            return m
+        return to_shard(jnp.broadcast_to(m, like.shape).astype(jnp.float32))
+
+    def from_shard(flat, like):
+        with jax.named_scope("update_shard_unpack"):
+            return unflatten_update_leaf(flat, like)
+
+    def update(grads, params, teacher, opt_state, momentum):
+        _check_sharded_opt_state(opt_state, grads, dp)
+        i = jnp.minimum(opt_state.count, lr_arr.shape[0] - 1)
+        lr_t, ll_lr_t, wd_t = lr_arr[i], ll_lr_arr[i], wd_arr[i]
+        count_inc = _safe_int32_increment(opt_state.adam.count)
+        bc1 = 1 - b1 ** count_inc
+        bc2 = 1 - b2 ** count_inc
+
+        g_flat = jax.tree.map(to_shard, grads)
+        norms = {}
+        if do_clip:
+            # the identical per_submodel_norms graph as the oracle, now
+            # over the flat sharded leaves: GSPMD lowers it as
+            # shard-local partial norms + one small psum
+            norms = per_submodel_norms(g_flat)
+            scales = {
+                k: jnp.minimum(1.0, clip_grad / jnp.maximum(n, 1e-12))
+                for k, n in norms.items()
+            }
+            scale_tree = {
+                k: jax.tree.map(lambda _, s=scales[k]: s, sub)
+                for k, sub in g_flat.items()
+            }
+        else:
+            scale_tree = jax.tree.map(lambda _: _NO_CLIP, g_flat)
+
+        p_flat = jax.tree.map(to_shard, params)
+        t_flat = (jax.tree.map(to_shard, teacher) if ema
+                  else jax.tree.map(lambda _: 0.0, g_flat))
+        lm_flat = jax.tree.map(mult_to_shard, lr_mult, params)
+        wm_flat = jax.tree.map(mult_to_shard, wd_mult, params)
+
+        def leaf(g, p, mu, nu, t, lm, wm, is_ll, scale):
+            return update_leaf_math(
+                g, p, mu, nu, t, lm, wm, is_ll, scale,
+                lr_t, ll_lr_t, wd_t, bc1, bc2, b1, b2, eps, momentum, ema,
+            )
+
+        n_out = 4 if ema else 3
+        fused = jax.tree.map(
+            leaf, g_flat, p_flat, opt_state.adam.mu, opt_state.adam.nu,
+            t_flat, lm_flat, wm_flat, is_last_layer, scale_tree,
+        )
+        outs = jax.tree.transpose(
+            jax.tree.structure(g_flat),
+            jax.tree.structure(tuple(range(n_out))),
+            fused,
+        )
+        if ema:
+            p_new_flat, new_mu, new_nu, t_new_flat = outs
+            new_teacher = jax.tree.map(from_shard, t_new_flat, teacher)
+        else:
+            p_new_flat, new_mu, new_nu = outs
+            new_teacher = teacher
+        # the jit-level out_shardings restore the model layout — this
+        # unflatten is where GSPMD inserts the param/teacher all-gather
+        new_params = jax.tree.map(from_shard, p_new_flat, params)
+        new_opt_state = ScheduledAdamWState(
+            count=opt_state.count + 1,
+            adam=optax.ScaleByAdamState(
+                count=count_inc, mu=new_mu, nu=new_nu
+            ),
+        )
+        return new_params, new_teacher, new_opt_state, norms
+
+    return update
+
+
+def build_sharded_update(
+    cfg, params: Any, schedules: Schedules, mesh: Any, ema: bool = True
+) -> Callable:
+    """Wire config -> multiplier trees -> sharded engine
+    (``build_fused_update``'s twin; same inputs, same validation)."""
+    lr_mult, wd_mult, is_last = build_multiplier_trees(
+        params,
+        layerwise_decay=cfg.optim.layerwise_decay,
+        patch_embed_lr_mult=cfg.optim.patch_embed_lr_mult,
+        dino_head_wd_multiplier=cfg.optim.dino_head_wd_multiplier,
+    )
+    if cfg.optim.optimizer != "adamw":
+        raise ValueError(
+            f"sharded update engine supports adamw only, got "
+            f"{cfg.optim.optimizer!r}; set optim.sharded_update=false"
+        )
+    return make_sharded_update(
+        schedules, lr_mult, wd_mult, is_last, mesh,
+        b1=cfg.optim.adamw_beta1, b2=cfg.optim.adamw_beta2,
+        clip_grad=cfg.optim.clip_grad, ema=ema,
+    )
+
+
+def make_sharded_update_schedule(
+    schedules: Schedules,
+    lr_mult: Any,
+    wd_mult: Any,
+    is_last_layer: Any,
+    mesh: Any,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    clip_grad: float | None = None,
+    ema: bool = True,
+) -> Callable:
+    """The sharded update schedule with EXPLICIT collectives.
+
+    ``make_sharded_update`` expresses the schedule through GSPMD
+    annotations, which this container's XLA:CPU lowers as all-reduce +
+    fused dynamic-slice (the pre-rewrite form of reduce-scatter; the
+    TPU/GPU collective optimizer performs that rewrite). This builder
+    writes the same schedule as a shard_map island whose collectives
+    are spelled out — ``psum_scatter`` (reduce-scatter) over the
+    stacked per-replica partial grads, shard-local
+    ``update_leaf_math``, ``all_gather`` of the updated student/teacher,
+    and ONE small psum for the per-submodel clip norms — so the
+    compiled HLO contains the literal reduce-scatter/all-gather ops on
+    every backend. scripts/cost_sharded_update.py compiles this program
+    for the committed collective census and per-device byte accounting;
+    tests/test_sharded_update.py pins both its numerics (against the
+    fused oracle) and its collective set.
+
+    Returns ``schedule(grad_partials, params, teacher, opt_state,
+    momentum) -> (new_params, new_teacher, new_opt_state, norms)`` where
+    ``grad_partials`` leaves are [dp, *leaf_shape] stacks of the
+    per-replica partial gradients (dim 0 sharded over the data axes —
+    what the data-parallel backward holds before any grad sync), and
+    ``opt_state`` is in the flat sharded layout (``sharded_adam_zeros``).
+    """
+    from dinov3_tpu.parallel.context import shard_map_compat
+    from dinov3_tpu.parallel.sharding import (
+        UPDATE_SHARD_AXES,
+        update_shard_size,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    dp = update_shard_size(mesh)
+    axes = tuple(a for a in UPDATE_SHARD_AXES if a in mesh.shape)
+    lr_arr = jnp.asarray(schedules.lr, jnp.float32)
+    ll_lr_arr = jnp.asarray(schedules.last_layer_lr, jnp.float32)
+    wd_arr = jnp.asarray(schedules.weight_decay, jnp.float32)
+    do_clip = clip_grad is not None and clip_grad > 0
+    shard_spec, rep_spec = P(axes), P()
+
+    def schedule(grad_partials, params, teacher, opt_state, momentum):
+        _check_sharded_opt_state(
+            opt_state, jax.tree.map(lambda g: g[0], grad_partials), dp
+        )
+        # flat padded shard-layout forms of everything the local body
+        # consumes (multipliers materialized per element, as in
+        # make_sharded_update; the in_specs slice each replica's shard)
+        p_flat = jax.tree.map(lambda p: flatten_update_leaf(p, dp), params)
+        t_flat = (jax.tree.map(lambda t: flatten_update_leaf(t, dp), teacher)
+                  if ema else jax.tree.map(lambda _: 0.0, grad_partials))
+        mults = jax.tree.map(
+            lambda m, p: m if getattr(m, "ndim", 0) == 0 else
+            flatten_update_leaf(
+                jnp.broadcast_to(m, p.shape).astype(jnp.float32), dp),
+            {"lm": lr_mult, "wm": wd_mult},
+            {"lm": params, "wm": params},
+        )
+        # per-leaf specs: scalar multipliers are replicated, flat padded
+        # leaves live in the shard layout
+        mults_spec = jax.tree.map(
+            lambda m: rep_spec if getattr(m, "ndim", 0) == 0 else shard_spec,
+            mults,
+        )
+        tf_spec = shard_spec if ema else rep_spec
+
+        def body(gp, pf, tf, mu, nu, ms, count, adam_count, mom):
+            i = jnp.minimum(count, lr_arr.shape[0] - 1)
+            lr_t, ll_lr_t, wd_t = lr_arr[i], ll_lr_arr[i], wd_arr[i]
+            count_inc = _safe_int32_increment(adam_count)
+            bc1 = 1 - b1 ** count_inc
+            bc2 = 1 - b2 ** count_inc
+            # reduce-scatter: each replica's full partial grad -> the
+            # cross-replica SUM of its own 1/dp shard
+            g_shard = jax.tree.map(
+                lambda g: jax.lax.psum_scatter(
+                    flatten_update_leaf(g[0], dp), axes,
+                    scatter_dimension=0, tiled=True),
+                gp,
+            )
+            norms = {}
+            if do_clip:
+                # shard-local partial norms + ONE small psum (a dict of
+                # scalars) — the whole-grad norms, never materializing
+                # a whole grad anywhere
+                partial = {
+                    k: sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                           for l in jax.tree.leaves(sub))
+                    for k, sub in g_shard.items()
+                }
+                norms = {k: jnp.sqrt(v)
+                         for k, v in jax.lax.psum(partial, axes).items()}
+                scale_tree = {
+                    k: jax.tree.map(
+                        lambda _, s=jnp.minimum(
+                            1.0, clip_grad / jnp.maximum(norms[k], 1e-12)
+                        ): s, sub)
+                    for k, sub in g_shard.items()
+                }
+            else:
+                scale_tree = jax.tree.map(lambda _: _NO_CLIP, g_shard)
+
+            def leaf(g, p, mu_l, nu_l, t, lm, wm, is_ll, scale):
+                return update_leaf_math(
+                    g, p, mu_l, nu_l, t, lm, wm, is_ll, scale,
+                    lr_t, ll_lr_t, wd_t, bc1, bc2, b1, b2, eps, mom, ema,
+                )
+
+            n_out = 4 if ema else 3
+            fused = jax.tree.map(
+                leaf, g_shard, pf, mu, nu, tf,
+                ms["lm"], ms["wm"], is_last_layer, scale_tree,
+            )
+            outs = jax.tree.transpose(
+                jax.tree.structure(g_shard),
+                jax.tree.structure(tuple(range(n_out))),
+                fused,
+            )
+            # all-gather: updated student (+ EMA'd teacher) shards back
+            # to every replica
+            def gather(x):
+                return jax.lax.all_gather(x, axes, tiled=True)
+
+            if ema:
+                p_new, new_mu, new_nu, t_new = outs
+                t_full = jax.tree.map(gather, t_new)
+            else:
+                p_new, new_mu, new_nu = outs
+                t_full = tf
+            p_full = jax.tree.map(gather, p_new)
+            return p_full, t_full, new_mu, new_nu, norms
+
+        p_full, t_full, new_mu, new_nu, norms = shard_map_compat(
+            body, mesh=mesh,
+            in_specs=(shard_spec, shard_spec, tf_spec, shard_spec,
+                      shard_spec, mults_spec, rep_spec, rep_spec, rep_spec),
+            out_specs=(rep_spec, rep_spec, shard_spec, shard_spec, rep_spec),
+            check_vma=False,
+        )(grad_partials, p_flat, t_flat, opt_state.adam.mu,
+          opt_state.adam.nu, mults, opt_state.count, opt_state.adam.count,
+          momentum)
+
+        new_params = jax.tree.map(unflatten_update_leaf, p_full, params)
+        new_teacher = (jax.tree.map(unflatten_update_leaf, t_full, teacher)
+                       if ema else teacher)
+        new_opt_state = ScheduledAdamWState(
+            count=opt_state.count + 1,
+            adam=optax.ScaleByAdamState(
+                count=_safe_int32_increment(opt_state.adam.count),
+                mu=new_mu, nu=new_nu,
+            ),
+        )
+        return new_params, new_teacher, new_opt_state, norms
+
+    return schedule

@@ -10,7 +10,11 @@ specs (dinov3_jax/train/train.py:319-604). Here:
 - the init is jitted with those ``NamedSharding``s as out_shardings, so
   each device materializes only its own shard (no replicate-then-slice),
 - the train step is jitted with donated state and explicit in/out
-  shardings; XLA's SPMD partitioner inserts all collectives.
+  shardings; XLA's SPMD partitioner inserts all collectives,
+- under the cross-replica sharded update engine (optim.sharded_update,
+  auto = on at data-parallel size > 1), the adam moments are born in the
+  flat "update_shard" layout — each replica stores and updates 1/dp of
+  every master/moment/teacher leaf (train/fused_update.py).
 """
 
 from __future__ import annotations
@@ -48,6 +52,7 @@ class TrainSetup:
     step_fn: Callable  # step_fn(state, batch, scalars, rng) -> (state, metrics)
     batch_shardings: dict
     fused_update: Callable | None = None  # single-pass engine, None = optax chain
+    sharded_update: bool = False  # cross-replica sharded form of the engine
 
     def scalars(self, iteration: int) -> dict:
         s = self.schedules.at(iteration)
@@ -86,13 +91,56 @@ def build_train_setup(
     # below are path-independent); optim.fused_update=false selects the
     # optax oracle chain
     fused = None
-    if cfg.optim.get("fused_update", True):
-        from dinov3_tpu.train.fused_update import build_fused_update
+    fused_wished = bool(cfg.optim.get("fused_update", True))
+    # cross-replica sharded update (train/fused_update.py
+    # make_sharded_update): auto = on when the data-parallel axis product
+    # is > 1 (each replica then updates 1/dp of every master/moment/
+    # teacher leaf and stores 1/dp of the adam moments); the replicated
+    # fused engine stays the oracle behind optim.sharded_update=false.
+    # The sharded engine is built on the fused single-pass math, so it
+    # only engages when fused_update is on.
+    from dinov3_tpu.parallel.sharding import update_shard_size
 
-        fused = build_fused_update(
-            cfg, abstract_params["student"], schedules,
-            ema=not meta.distillation,
+    dp = update_shard_size(mesh)
+    sharded_wished = cfg.optim.get("sharded_update", "auto")
+    if isinstance(sharded_wished, str):
+        sharded_wished = sharded_wished.lower() in ("auto", "true", "on")
+    use_sharded = bool(sharded_wished) and fused_wished and dp > 1
+    if (bool(sharded_wished) and not fused_wished
+            and str(cfg.optim.get("sharded_update", "auto")).lower()
+            not in ("auto",)):
+        raise ValueError(
+            "optim.sharded_update=true requires optim.fused_update=true "
+            "(the sharded engine is the fused single-pass math over "
+            "1/dp shards); set sharded_update=false or re-enable "
+            "fused_update"
         )
+    if fused_wished:
+        from dinov3_tpu.train.fused_update import (
+            build_fused_update,
+            build_sharded_update,
+        )
+
+        if use_sharded:
+            fused = build_sharded_update(
+                cfg, abstract_params["student"], schedules, mesh,
+                ema=not meta.distillation,
+            )
+            # padding guardrail: warn when the per-leaf zero-padding to
+            # a multiple of dp wastes > 1% of the flat master size
+            from dinov3_tpu.configs.config import warn_update_shard_padding
+            from dinov3_tpu.train.fused_update import leaf_size
+
+            warn_update_shard_padding(
+                [leaf_size(l)
+                 for l in jax.tree.leaves(abstract_params["student"])],
+                dp,
+            )
+        else:
+            fused = build_fused_update(
+                cfg, abstract_params["student"], schedules,
+                ema=not meta.distillation,
+            )
 
     def boxed_init(r):
         params = meta.init_params(r, example_batch, unbox=False)
@@ -100,6 +148,23 @@ def build_train_setup(
         # mu/nu trees inherit the logical-axis boxes — one eval_shape
         # covers params and optimizer state.
         opt_state = optimizer.init(params["student"])
+        if use_sharded:
+            # the sharded engine's moments are BORN in the flat
+            # "update_shard" layout (1/dp per replica, ZeRO-1) — same
+            # ScheduledAdamWState pytree, flat padded mu/nu leaves
+            import flax.linen as nn
+            import optax
+
+            from dinov3_tpu.train.fused_update import sharded_adam_zeros
+
+            student_unboxed = nn.meta.unbox(params["student"])
+            opt_state = opt_state._replace(
+                adam=optax.ScaleByAdamState(
+                    count=opt_state.adam.count,
+                    mu=sharded_adam_zeros(student_unboxed, dp),
+                    nu=sharded_adam_zeros(student_unboxed, dp),
+                )
+            )
         return TrainState(
             params=params,
             opt_state=opt_state,
@@ -145,6 +210,7 @@ def build_train_setup(
         cfg=cfg, meta=meta, mesh=mesh, schedules=schedules,
         optimizer=optimizer, state=state, state_shardings=state_shardings,
         step_fn=step_fn, batch_shardings=b_shardings, fused_update=fused,
+        sharded_update=use_sharded,
     )
 
 

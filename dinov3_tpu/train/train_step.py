@@ -8,15 +8,22 @@ Fusing the EMA both fixes the reference's frozen-teacher bug by construction
 (SURVEY.md §2.9.1) and lets XLA overlap the EMA's elementwise work with the
 optimizer update.
 
-The update phase itself has two implementations:
+The update phase itself has three implementations:
 - the optax reference chain (clip -> scale_by_adam -> apply -> EMA, four
   sequential tree passes) — the test oracle, selected by
   ``optim.fused_update=false``;
-- the single-pass fused engine (train/fused_update.py, default): one
-  tree.map reading each fp32 master/moment/teacher leaf once and writing
-  it once, attacking the ~12 ms/step weight-shaped HBM floor the r5
-  profile put inside the 28.5% norm/reduce bucket (PROFILE_r05.json,
-  docs/PERFORMANCE.md).
+- the single-pass fused engine (train/fused_update.py): one tree.map
+  reading each fp32 master/moment/teacher leaf once and writing it once,
+  attacking the ~12 ms/step weight-shaped HBM floor the r5 profile put
+  inside the 28.5% norm/reduce bucket (PROFILE_r05.json,
+  docs/PERFORMANCE.md);
+- the cross-replica SHARDED form of that engine (default whenever the
+  data-parallel axis product is > 1, ``optim.sharded_update``): the
+  grads are reduce-scattered, each replica runs the same single pass
+  over 1/dp of every leaf (moments stored sharded — ZeRO-1), and the
+  updated student/teacher are all-gathered back into model layout. Both
+  fused forms plug in through the same ``fused_update`` callable below —
+  the step body cannot tell them apart.
 
 Step randomness likewise has two implementations (the copy/small-op
 sink, 14.8% of the r5 profile): the step-wide RNG plan (rng/plan.py,

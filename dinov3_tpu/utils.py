@@ -263,6 +263,12 @@ def classify_copy(line: str) -> str:
       pad/reshape/concat/slice traffic the packing engine introduces,
       attributed so the census ceiling names it instead of silently
       absorbing it.
+    - "update_shard": copies inside the sharded update engine's
+      flatten/pad/unflatten walk (the ``update_shard_pack``/
+      ``update_shard_unpack`` named scopes in
+      train/fused_update.py make_sharded_update) — the leaf-layout
+      traffic the cross-replica sharding introduces, named for the same
+      reason.
     - "rng": u32 results of <= 8 elements — threefry key/counter
       plumbing (keys are u32[2]/u32[4]; fold_in intermediates scalar).
     - "small": any other result of <= 1024 elements (scalar metrics,
@@ -274,6 +280,8 @@ def classify_copy(line: str) -> str:
         return "donation_async"
     if "crop_pack" in line or "crop_unpack" in line:
         return "gather_pack"
+    if "update_shard_pack" in line or "update_shard_unpack" in line:
+        return "update_shard"
     shp = _hlo_result_shape(line)
     if shp is None:
         return "small"
@@ -311,4 +319,91 @@ def hlo_copy_census(hlo_text: str) -> dict:
         "hlo_copy_total": sum(counts.values()),
         "hlo_copy_bytes": bytes_total,
         "by_category": by_cat,
+    }
+
+
+# ---------------- compiled-HLO collective census (shared by
+# scripts/cost_sharded_update.py and `bench.py --census`) ----------------
+
+# collective op kinds the census attributes; anything else that smells
+# like a collective lands in "unattributed" — a structural regression
+# when it appears (the sharded-update census pins it at 0)
+HLO_COLLECTIVE_CLASSES = {
+    "all-reduce": "all_reduce",
+    "reduce-scatter": "reduce_scatter",
+    "all-gather": "all_gather",
+    "collective-permute": "ppermute",
+    "all-to-all": "all_to_all",
+}
+
+# collective-looking op kinds OUTSIDE the attributed set: their
+# appearance classifies as "unattributed" (a stray the ceiling names)
+_HLO_COLLECTIVE_UNATTRIBUTED = ("collective-broadcast", "ragged-all-to-all")
+
+
+def classify_collective(line: str) -> str | None:
+    """Attribution class for one HLO instruction line, or None when the
+    line is not a collective (or is the ``-done`` half of an async pair,
+    which is counted at its ``-start``).
+
+    Classes: "all_reduce" (the replicated engine's grad sync),
+    "reduce_scatter" (the sharded engine's grad sync — each replica
+    receives the summed 1/dp shard), "all_gather" (updated params back
+    to every replica), "ppermute" (ring/pipeline transfers),
+    "all_to_all" (resharding), "unattributed" (any other collective —
+    a stray the census ceiling must name).
+
+    Matching is by opcode token (the name followed by "(", preceded by
+    whitespace or a closing bracket) rather than by result-type parsing,
+    so tuple-typed async forms (``all-reduce-start`` et al.) classify on
+    every backend's text format. Longest names are tested first so
+    ``all-reduce`` can never claim a ``reduce-scatter`` line.
+    """
+    import re
+
+    if "=" not in line:
+        return None
+    names = sorted(
+        list(HLO_COLLECTIVE_CLASSES) + list(_HLO_COLLECTIVE_UNATTRIBUTED),
+        key=len, reverse=True,
+    )
+    for base in names:
+        esc = re.escape(base)
+        if re.search(r"[\s)]" + esc + r"-done\(", line):
+            return None  # async pair's -done half: counted at -start
+        if re.search(r"[\s)]" + esc + r"(-start)?\(", line):
+            return HLO_COLLECTIVE_CLASSES.get(base, "unattributed")
+    return None
+
+
+def hlo_collective_census(hlo_text: str) -> dict:
+    """Collective op counts + result bytes per class for one compiled
+    HLO module (non-fusion lines; ``-start``/plain forms counted once,
+    ``-done`` halves skipped).
+
+    Result bytes are the PER-DEVICE output of each collective — for an
+    all-reduce that is the full buffer, for a reduce-scatter the 1/dp
+    shard, for an all-gather the re-assembled full buffer — so the
+    by-class byte totals read directly as the per-device collective
+    traffic story of the module. Classes: see ``classify_collective``.
+    """
+    by_class: dict = {}
+    total_ops = 0
+    total_bytes = 0
+    for line in hlo_non_fusion_lines(hlo_text):
+        cat = classify_collective(line)
+        if cat is None:
+            continue
+        shp = _hlo_result_shape(line)
+        nbytes = shp[2] if shp else 0
+        ent = by_class.setdefault(cat, {"ops": 0, "bytes": 0})
+        ent["ops"] += 1
+        ent["bytes"] += nbytes
+        total_ops += 1
+        total_bytes += nbytes
+    return {
+        "hlo_collective_total": total_ops,
+        "hlo_collective_bytes": total_bytes,
+        "by_class": by_class,
+        "unattributed": by_class.get("unattributed", {"ops": 0})["ops"],
     }

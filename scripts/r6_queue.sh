@@ -43,6 +43,20 @@
 #         B=12; the packed attention's extra score bytes are the
 #         documented trade — this measures which side the TPU
 #         scheduler lands on.
+#   phZ   cross-replica sharded update engine A/B (the dp-redundant
+#         update-phase attack, train/fused_update.py
+#         make_sharded_update): default program (optim.sharded_update
+#         auto=on at dp>1) vs =false replicated-fused control, same
+#         session, both arms pinned BENCH_PROBS=bf16 AND BENCH_CENSUS=1
+#         so each record embeds the copy census AND the collective
+#         census (utils.hlo_collective_census) — the grad-sync story
+#         (all-reduce vs reduce-scatter+all-gather after the TPU
+#         collective-optimizer rewrite) lands in the same JSONL row as
+#         the throughput delta. Host-side accounting
+#         (scripts/cost_sharded_update.py, COST_SHUP_r10.json): -80%
+#         per-device update-phase weight-shaped bytes at dp=8 ViT-L,
+#         RS+AG census with zero unattributed collectives; this
+#         measures what the TPU scheduler does with each form.
 #   phG2  fixed op-level flash-vs-dense attention crossover
 #         (scripts/crossover_attention.py): the
 #         kernels.flash_min_seq=2048 boundary is measured only at
@@ -179,6 +193,15 @@ run_bench phR_rngplan_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
 run_bench phP_packed_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1
 run_bench phP_packed_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=model.crop_packing=false
+
+# phZ: cross-replica sharded update engine A/B. Treatment = the
+# committed default program (optim.sharded_update auto = on at dp > 1);
+# control strips ONLY the engine (replicated fused update). Both arms
+# carry the copy + collective censuses of the exact benched program so
+# the grad-sync collective story lands next to the throughput delta.
+run_bench phZ_sharded_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1
+run_bench phZ_sharded_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=optim.sharded_update=false
 
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including
