@@ -62,6 +62,15 @@ throughput; the sharded-update A/B (r6_queue phZ) reads the
 all-reduce-vs-reduce-scatter grad-sync story straight from
 ``collective_census.by_class``; use the env form under supervision,
 argv does not propagate to the measurement child).
+
+The benched step is the DEFAULT program, which under async telemetry
+(telemetry.async_metrics auto=on) is the telemetry step — metrics row
+into a donated on-device ring, no per-step host sync. Every record
+embeds a "telemetry" summary: the arm, the measure loop's blocking
+device->host fetch count + host-blocked ms (telemetry/host_sync.py —
+the COST_HSYNC_r11.json instrument), and device memory samples at the
+setup/compile/measure boundaries. The phO A/B (r6_queue.sh) pins
+BENCH_OVERRIDES=telemetry.async_metrics=false as the control arm.
 """
 
 from __future__ import annotations
@@ -626,6 +635,18 @@ def main():
     state = setup.state
     scalars = setup.scalars(0)
 
+    # the benched step is the DEFAULT program: under async telemetry
+    # (telemetry.async_metrics auto=on) that is the telemetry step —
+    # metrics row into the donated device ring, no per-step host sync —
+    # so the phO A/B (BENCH_OVERRIDES=telemetry.async_metrics=false
+    # control) measures the ring write's real cost
+    from dinov3_tpu.telemetry import blocking_fetch, host_sync_stats
+    from dinov3_tpu.telemetry.memory import sample_memory
+
+    plan = setup.telemetry()
+    ring = plan.init_ring() if plan is not None else None
+    mem_setup = sample_memory()
+
     _phase("compile")
     import warnings as _warnings
 
@@ -635,9 +656,15 @@ def main():
     # A/B labeled "subset" can never silently be the mask program
     with _warnings.catch_warnings(record=True) as _caught:
         _warnings.simplefilter("always")
-        compiled = setup.step_fn.lower(state, dbatch, scalars, rng).compile()
+        if plan is not None:
+            compiled = plan.step_fn.lower(
+                state, ring, dbatch, scalars, rng).compile()
+        else:
+            compiled = setup.step_fn.lower(
+                state, dbatch, scalars, rng).compile()
     degraded = [str(w.message) for w in _caught
                 if "degraded to mask semantics" in str(w.message)]
+    mem_compile = sample_memory()
     _log("compile done")
 
     census = None
@@ -670,18 +697,35 @@ def main():
     steps = max(1, steps)
     _phase("warmup")
     # synchronize via a value fetch: block_until_ready can return early
-    # through the tunneled-TPU transport, a fetch cannot
-    for _ in range(warmup):
-        state, metrics = compiled(state, dbatch, scalars, rng)
-    if warmup:
-        float(metrics["total_loss"])
+    # through the tunneled-TPU transport, a fetch cannot (the telemetry
+    # arm fetches the ring's streak scalar — 4 bytes — since its step
+    # has no metrics output; both fetches go through the counted
+    # telemetry funnel)
+    if plan is not None:
+        for _ in range(warmup):
+            state, ring = compiled(state, ring, dbatch, scalars, rng)
+        if warmup:
+            blocking_fetch(ring.nonfinite_streak)
+    else:
+        for _ in range(warmup):
+            state, metrics = compiled(state, dbatch, scalars, rng)
+        if warmup:
+            blocking_fetch(metrics["total_loss"])
 
     _phase("measure")
+    host_sync_stats(reset=True)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = compiled(state, dbatch, scalars, rng)
-    float(metrics["total_loss"])
+    if plan is not None:
+        for _ in range(steps):
+            state, ring = compiled(state, ring, dbatch, scalars, rng)
+        blocking_fetch(ring.nonfinite_streak)
+    else:
+        for _ in range(steps):
+            state, metrics = compiled(state, dbatch, scalars, rng)
+        blocking_fetch(metrics["total_loss"])
     dt = (time.perf_counter() - t0) / steps
+    hsync = host_sync_stats()
+    mem_measure = sample_memory()
     _phase("report")
 
     img_s_chip = B / dt / n
@@ -695,6 +739,18 @@ def main():
         # record carries the fixed calibration rung (see docs/PERFORMANCE.md
         # "Session calibration")
         "calib": calib,
+        # telemetry summary: which metrics arm was benched, the measure
+        # loop's blocking-fetch count + host-blocked wall time (the
+        # COST_HSYNC_r11.json instrument), and memory samples at the
+        # setup/compile/measure boundaries (telemetry/memory.py)
+        "telemetry": {
+            "async_metrics": plan is not None,
+            "ring_len": plan.ring_len if plan is not None else None,
+            "n_metrics": len(plan.metric_names) if plan is not None else None,
+            "host_sync_measure": {**hsync, "steps": steps},
+            "memory": {"setup": mem_setup, "compile": mem_compile,
+                       "measure": mem_measure},
+        },
     }
     if census is not None:
         rec["copy_census"] = census

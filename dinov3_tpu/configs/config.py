@@ -182,6 +182,11 @@ def load_config(
     # row count (2B + P, the crop-packed program) — 96 rows of 37
     # tokens is precisely the pathology the packing engine removes
     warn_student_row_tiling(cfg)
+    # ... and over the telemetry flush window: metrics rows still in the
+    # on-device ring when a run restarts are dropped, so a flush period
+    # wider than the checkpoint/eval cadence silently loses exactly the
+    # rows around the events one most wants recorded
+    warn_telemetry_flush_period(cfg)
     return cfg
 
 
@@ -350,6 +355,51 @@ def warn_update_shard_padding(
         f"padding through its 1/dp update shard each step "
         f"(train/fused_update.py). Use a smaller data-parallel axis for "
         f"this model, or set optim.sharded_update=false."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
+
+
+def warn_telemetry_flush_period(
+    cfg: ConfigNode, stacklevel: int = 2,
+) -> str | None:
+    """Warn when ``telemetry.flush_every`` exceeds the checkpoint period
+    or the eval period — the axis-labelled guardrail style of
+    ``warn_update_shard_padding``.
+
+    The async metrics engine (telemetry/ring.py) holds up to
+    ``flush_every`` metric rows on device between flushes; a restart
+    drops whatever is still in the ring, and the non-finite 3-strike
+    abort is delayed by up to a full window. When the window is wider
+    than ``checkpointing.period`` (rows spanning a restart are
+    guaranteed droppable) or the eval cadence (an eval's surrounding
+    training metrics lag it in the record), the period is almost
+    certainly misconfigured. Fired at config build (``load_config``);
+    returns the message, or None when the window is fine or the async
+    engine is off."""
+    from dinov3_tpu.telemetry import telemetry_wished
+
+    if not telemetry_wished(cfg):
+        return None
+    flush_every = int((cfg.get("telemetry") or {}).get("flush_every", 50))
+    offenders = []
+    ckpt_period = int(cfg.checkpointing.period)
+    if ckpt_period > 0 and flush_every > ckpt_period:
+        offenders.append(f"checkpointing.period={ckpt_period}")
+    eval_period = int(cfg.evaluation.get("eval_period_iterations", 0) or 0)
+    if eval_period > 0 and flush_every > eval_period:
+        offenders.append(f"evaluation.eval_period_iterations={eval_period}")
+    if not offenders:
+        return None
+    msg = (
+        f"telemetry flush window: telemetry.flush_every={flush_every} "
+        f"exceeds {' and '.join(offenders)} — metrics rows still in the "
+        f"on-device ring at a restart are dropped, and the non-finite "
+        f"abort lags by up to a full window (telemetry/ring.py). Lower "
+        f"telemetry.flush_every, or set telemetry.async_metrics=false "
+        f"for the per-step-fetch oracle."
     )
     import warnings
 

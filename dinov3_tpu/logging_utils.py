@@ -164,6 +164,21 @@ class MetricLogger:
                 v = float(v)
             self.meters[k].update(float(v))
 
+    def consume_flush(self, names, iterations, rows, scheds=None) -> None:
+        """Consume one flushed telemetry batch (telemetry/ring.py
+        RingReader.flush): one meter update per row, in iteration
+        order, so the windowed medians see every step's exact value —
+        the meters just advance in bursts of up to
+        ``telemetry.flush_every`` instead of per step. ``scheds`` is an
+        optional ``iteration -> dict`` of host-side schedule values
+        (lr/wd/momentum/teacher_temp) merged into each row's update,
+        replacing the oracle loop's per-step ``schedules.at`` call."""
+        for j, it in enumerate(iterations):
+            kwargs = dict(zip(names, (float(v) for v in rows[j])))
+            if scheds is not None:
+                kwargs.update(scheds(int(it)))
+            self.update(**kwargs)
+
     def close(self) -> None:
         if self._tb is not None:
             self._tb.close()

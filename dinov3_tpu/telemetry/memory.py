@@ -1,0 +1,94 @@
+"""Per-device memory accounting.
+
+Two instruments, same record shape:
+
+- ``sample_memory()``: runtime sampling via ``device.memory_stats()``
+  (bytes-in-use / peak-bytes-in-use — the TPU/GPU allocator's own
+  numbers). Backends whose devices expose no stats (this container's
+  XLA:CPU) fall back to summing the addressable shards of every live
+  jax array per device — honest bytes-in-use with ``"source":
+  "live_arrays"``, no peak (the allocator owns peak; a walker cannot
+  reconstruct it).
+- ``per_device_state_bytes(tree)``: the sharding-aware footprint of one
+  pytree (train state, ring, batch) — per-device bytes from each leaf's
+  addressable shards. This is the SimpleFSDP-style deliverable the
+  ZeRO-3 work (ROADMAP item 1) diffs before/after sharding the
+  masters: it reads the layout the partitioner actually chose, not the
+  logical shapes.
+
+Sampled at setup/compile boundaries and at every metrics flush
+(train/train.py via ``SpanTracer.emit_memory``), and summarized into
+the committed ``MEM_r11.json`` by scripts/cost_host_sync.py.
+"""
+
+from __future__ import annotations
+
+
+def _live_bytes_by_device() -> dict:
+    """{device: bytes} summed over addressable shards of live arrays."""
+    import jax
+
+    by_dev: dict = {}
+    for arr in jax.live_arrays():
+        try:
+            shards = arr.addressable_shards
+        except Exception:  # noqa: BLE001 - deleted/donated arrays mid-walk
+            continue
+        for sh in shards:
+            data = sh.data
+            by_dev[sh.device] = by_dev.get(sh.device, 0) + int(data.nbytes)
+    return by_dev
+
+
+def sample_memory(devices=None) -> dict:
+    """One memory sample: ``{"devices": [{id, platform, bytes_in_use,
+    peak_bytes_in_use, source}, ...]}`` over the local devices."""
+    import jax
+
+    devices = list(devices) if devices is not None else jax.local_devices()
+    live = None
+    out = []
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 - backend without stats support
+            stats = None
+        rec = {"id": int(d.id), "platform": str(d.platform)}
+        if stats:
+            rec["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+            peak = stats.get("peak_bytes_in_use")
+            rec["peak_bytes_in_use"] = None if peak is None else int(peak)
+            rec["source"] = "memory_stats"
+        else:
+            if live is None:
+                live = _live_bytes_by_device()
+            rec["bytes_in_use"] = int(live.get(d, 0))
+            rec["peak_bytes_in_use"] = None
+            rec["source"] = "live_arrays"
+        out.append(rec)
+    return {"devices": out}
+
+
+def per_device_state_bytes(tree) -> dict:
+    """Sharding-aware per-device footprint of one pytree.
+
+    Returns ``{"per_device": {device_id: bytes}, "total": bytes,
+    "max_per_device": bytes}`` — replicated leaves count once per
+    device, sharded leaves only their local shard, exactly what each
+    HBM actually holds.
+    """
+    import jax
+
+    per_dev: dict = {}
+    for leaf in jax.tree.leaves(tree):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        for sh in leaf.addressable_shards:
+            did = int(sh.device.id)
+            per_dev[did] = per_dev.get(did, 0) + int(sh.data.nbytes)
+    return {
+        "per_device": per_dev,
+        "total": sum(per_dev.values()),
+        "max_per_device": max(per_dev.values()) if per_dev else 0,
+    }

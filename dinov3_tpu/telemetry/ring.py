@@ -1,0 +1,128 @@
+"""On-device metrics ring buffer: the async half of the telemetry engine.
+
+The jitted step writes its scalar metrics into a donated ``[K, M]``
+float32 ring (K = ``telemetry.flush_every``, M = number of metrics, one
+``dynamic-update-slice`` per step under the ``telemetry_ring`` named
+scope so the copy census attributes it), stamps the row's iteration
+into a parallel ``[K]`` int32 array, and maintains one device-side
+finite-flag scalar: the streak of consecutive steps whose
+``total_loss`` was non-finite. Nothing crosses the device->host
+boundary per step; the host flushes the whole ring once per K steps
+with a single ``blocking_fetch`` and replays the rows — exact per-step
+values, iteration-stamped — into the MetricLogger / LossRecorder /
+LossComparator. The streak scalar preserves the trainer's 3-strike
+non-finite abort with flush-granularity latency (an abort decision can
+arrive up to K-1 steps late, never wrong: the streak counts on device
+every step).
+
+Resume mid-ring: the slot index is ``iteration % K`` and rows are
+iteration-stamped, so a restart at an iteration not aligned to K just
+begins a partial window — the ``RingReader`` starts its cursor at the
+restored iteration and the first flush covers the short window. Stamp
+mismatches (a slot not holding the iteration the reader expects) raise:
+they can only come from a structural bug (flush window wider than the
+ring, reader cursor drift), never from normal wraparound.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import numpy as np
+
+
+class RingState(NamedTuple):
+    """Device-side telemetry state threaded through the jitted step."""
+
+    buf: Any               # [K, M] f32 metric rows
+    its: Any               # [K] i32 iteration stamp per row (-1 = unwritten)
+    nonfinite_streak: Any  # i32 consecutive non-finite total_loss steps
+
+
+def make_ring(n_metrics: int, ring_len: int) -> RingState:
+    """Host-side zeroed ring (place on device with the replicated
+    sharding; the step donates it thereafter)."""
+    if ring_len < 1:
+        raise ValueError(f"telemetry ring length must be >= 1, got {ring_len}")
+    return RingState(
+        buf=np.zeros((ring_len, n_metrics), np.float32),
+        its=np.full((ring_len,), -1, np.int32),
+        nonfinite_streak=np.zeros((), np.int32),
+    )
+
+
+def write_row(ring: RingState, iteration, metrics: dict, names,
+              loss_key: str = "total_loss") -> RingState:
+    """Write one step's metrics into the ring (traced, in-graph).
+
+    ``iteration`` is the step's own counter (``state.step`` BEFORE the
+    increment); the slot is ``iteration % K``. All metrics must be
+    scalars — the ring stores exact f32 values, which is what the
+    oracle's ``float(v)`` fetch reads too, so oracle-vs-ring metric
+    equality is bitwise (tests/test_telemetry.py).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    for name in names:
+        if jnp.shape(metrics[name]) != ():
+            raise ValueError(
+                f"telemetry ring stores scalar metrics only; {name!r} has "
+                f"shape {jnp.shape(metrics[name])}"
+            )
+    k = ring.its.shape[0]
+    it = jnp.asarray(iteration, jnp.int32)
+    slot = jnp.mod(it, k)
+    row = jnp.stack([metrics[n].astype(jnp.float32) for n in names])
+    with jax.named_scope("telemetry_ring"):
+        buf = jax.lax.dynamic_update_slice(
+            ring.buf, row[None, :], (slot, jnp.int32(0)))
+        its = jax.lax.dynamic_update_slice(ring.its, it[None], (slot,))
+    finite = jnp.isfinite(metrics[loss_key].astype(jnp.float32))
+    streak = jnp.where(finite, jnp.int32(0), ring.nonfinite_streak + 1)
+    return RingState(buf=buf, its=its, nonfinite_streak=streak)
+
+
+class RingReader:
+    """Host-side consumer: one blocking fetch per flush, rows replayed
+    in iteration order.
+
+    ``flush(ring, upto_iteration)`` returns ``(iterations [n] int64,
+    rows [n, M] float32, nonfinite_streak int)`` for the iterations
+    ``[cursor, upto_iteration)`` written since the previous flush, and
+    advances the cursor. ``n`` may be 0 (nothing new) up to the ring
+    length; asking for a wider window than the ring holds raises — the
+    caller's flush schedule must satisfy ``upto - cursor <= K``.
+    """
+
+    def __init__(self, names, ring_len: int, start_iteration: int = 0):
+        self.names = list(names)
+        self.ring_len = int(ring_len)
+        self.cursor = int(start_iteration)
+
+    def flush(self, ring: RingState, upto_iteration: int):
+        from dinov3_tpu.telemetry.host_sync import blocking_fetch
+
+        upto = int(upto_iteration)
+        n = upto - self.cursor
+        if n < 0 or n > self.ring_len:
+            raise RuntimeError(
+                f"telemetry flush window [{self.cursor}, {upto}) does not "
+                f"fit the ring (K={self.ring_len}); flush at least every "
+                "K steps"
+            )
+        buf, its, streak = blocking_fetch(
+            (ring.buf, ring.its, ring.nonfinite_streak))
+        out_its = np.arange(self.cursor, upto, dtype=np.int64)
+        slots = out_its % self.ring_len
+        got = np.asarray(its)[slots]
+        if not np.array_equal(got, out_its.astype(np.int32)):
+            raise RuntimeError(
+                "telemetry ring stamp mismatch: expected iterations "
+                f"{out_its.tolist()} at slots {slots.tolist()}, ring holds "
+                f"{got.tolist()} — reader cursor drifted from the device "
+                "ring (structural bug, not wraparound)"
+            )
+        rows = np.asarray(buf)[slots]
+        self.cursor = upto
+        return out_its, rows, int(streak)

@@ -1,0 +1,218 @@
+"""Host phase-span tracer + per-process heartbeat + benchmark fence.
+
+``SpanTracer`` records monotonic-clock spans around the hot loop's host
+phases — data-wait, h2d ``put_batch``, step dispatch, metrics flush,
+gram refresh, eval, checkpoint save — as JSON lines in
+``<output-dir>/telemetry/spans[.rankN].jsonl``:
+
+    {"name": "dispatch", "iteration": 17, "t": <epoch s at start>,
+     "dur_ms": 1.84}
+
+Durations come from ``time.perf_counter`` (monotonic); ``t`` is wall
+epoch time for cross-process alignment only. Memory samples ride the
+same stream as ``{"name": "memory", "point": "flush", ...}`` records
+(telemetry/memory.py).
+
+The heartbeat file (``<output-dir>/telemetry/heartbeat[.rankN]``) is
+rewritten at most once per ``heartbeat_every`` iterations with the last
+iteration + wall time; its MTIME is the liveness primitive — a stalled
+process (data-loader deadlock, dead collective, hung compile) stops
+advancing it, which is the stall signal the elastic/preemption work
+(ROADMAP item 4) polls for without parsing anything.
+
+The ``--profile-steps`` jax.profiler trace window is folded in
+(``profile_step_begin``/``profile_step_end``), so the span stream and
+the profiler trace cover the same iterations when both are on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+
+logger = logging.getLogger("dinov3")
+
+# the hot-loop phase names train/train.py emits — one vocabulary, shared
+# with tests (schema validation) and docs/PERFORMANCE.md
+PHASES = (
+    "data_wait", "h2d", "dispatch", "metrics_fetch", "metrics_flush",
+    "gram_refresh", "eval", "checkpoint_save",
+)
+
+
+class SpanTracer:
+    """JSONL span recorder + heartbeat. ``enabled=False`` turns every
+    method into a no-op (the oracle arms and non-traced tools pay
+    nothing)."""
+
+    def __init__(self, output_dir: str | None, rank: int = 0,
+                 enabled: bool = True, heartbeat_every: int = 1,
+                 profile_steps: tuple[int, int] | None = None,
+                 profile_dir: str | None = None):
+        self.enabled = bool(enabled and output_dir)
+        self.heartbeat_every = max(1, int(heartbeat_every))
+        self._profile = profile_steps
+        self._profile_dir = profile_dir
+        self._profiling = False
+        self._f = None
+        self.spans_path = self.heartbeat_path = None
+        if not self.enabled:
+            return
+        tdir = os.path.join(output_dir, "telemetry")
+        os.makedirs(tdir, exist_ok=True)
+        suffix = "" if rank == 0 else f".rank{rank}"
+        self.spans_path = os.path.join(tdir, f"spans{suffix}.jsonl")
+        self.heartbeat_path = os.path.join(tdir, f"heartbeat{suffix}")
+        self._f = open(self.spans_path, "a")
+
+    # ---- spans ----
+
+    @contextlib.contextmanager
+    def span(self, name: str, iteration: int | None = None):
+        if not self.enabled:
+            yield
+            return
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.emit({
+                "name": name,
+                "iteration": None if iteration is None else int(iteration),
+                "t": round(t_wall, 6),
+                "dur_ms": round((time.perf_counter() - t0) * 1e3, 4),
+            })
+
+    def emit(self, record: dict) -> None:
+        """Append one JSONL record (buffered; flushed by ``beat`` and
+        ``close`` so the span stream trails liveness by at most one
+        heartbeat)."""
+        if self._f is not None:
+            self._f.write(json.dumps(record) + "\n")
+
+    def wrap_iter(self, iterable, name: str = "data_wait",
+                  start_iteration: int = 0):
+        """Time each ``next()`` of ``iterable`` as a span — the
+        data-wait phase, traced without restructuring the driving
+        ``MetricLogger.log_every`` loop."""
+        if not self.enabled:
+            yield from iterable
+            return
+        it = iter(iterable)
+        i = int(start_iteration)
+        while True:
+            with self.span(name, i):
+                try:
+                    obj = next(it)
+                except StopIteration:
+                    return
+            yield obj
+            i += 1
+
+    # ---- heartbeat ----
+
+    def beat(self, iteration: int) -> None:
+        """Advance the heartbeat file's mtime (at most once per
+        ``heartbeat_every`` iterations) and flush buffered spans."""
+        if not self.enabled or iteration % self.heartbeat_every:
+            return
+        self._f.flush()
+        with open(self.heartbeat_path, "w") as hb:
+            hb.write(json.dumps(
+                {"iteration": int(iteration), "t": round(time.time(), 6)}))
+
+    # ---- memory samples (ride the span stream) ----
+
+    def emit_memory(self, point: str, iteration: int | None = None) -> None:
+        if not self.enabled:
+            return
+        from dinov3_tpu.telemetry.memory import sample_memory
+
+        self.emit({
+            "name": "memory",
+            "point": point,
+            "iteration": None if iteration is None else int(iteration),
+            "t": round(time.time(), 6),
+            **sample_memory(),
+        })
+
+    # ---- jax.profiler trace window (--profile-steps) ----
+
+    def profile_step_begin(self, iteration: int) -> None:
+        if self._profile and iteration == self._profile[0]:
+            import jax
+
+            jax.profiler.start_trace(self._profile_dir)
+            self._profiling = True
+            self.emit({"name": "profile_start", "iteration": int(iteration),
+                       "t": round(time.time(), 6)})
+
+    def profile_step_end(self, iteration: int, state=None) -> None:
+        if self._profile and self._profiling \
+                and iteration == self._profile[1]:
+            import jax
+
+            if state is not None:
+                jax.tree.leaves(state.params)[0].block_until_ready()
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self.emit({"name": "profile_stop", "iteration": int(iteration),
+                       "t": round(time.time(), 6)})
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+            self._f.close()
+            self._f = None
+
+
+class StepTimer:
+    """Steady-state ``--benchmark`` timer with an EXPLICIT fence.
+
+    The old timing free-rode on the per-step metrics fetch ("the
+    metrics fetch above synced, so the step has completed") — which the
+    async ring removes, leaving nothing between timestamp and dispatch.
+    ``mark(state)`` fences with one tiny value fetch (``state.step``,
+    4 bytes, through the counted ``blocking_fetch`` funnel — a fetch,
+    not ``block_until_ready``, for the tunneled-TPU reason bench.py
+    documents) and then timestamps, so both telemetry arms time
+    completed steps. On the oracle arm the fence lands after the
+    metrics fetch already synced and costs ~nothing — the two timing
+    methods agree there (pinned in tests/test_telemetry.py).
+    """
+
+    def __init__(self, n_steps: int, total_iters: int):
+        self.n = max(0, int(n_steps))
+        self.total = int(total_iters)
+        self.times: list[float] = []
+
+    def active(self, iteration: int) -> bool:
+        """One extra leading mark gives N measured intervals (the
+        original windowing)."""
+        return bool(self.n) and iteration >= self.total - self.n - 1
+
+    def mark(self, state=None) -> None:
+        if state is not None:
+            from dinov3_tpu.telemetry.host_sync import blocking_fetch
+
+            blocking_fetch(state.step)
+        self.times.append(time.perf_counter())
+
+    @property
+    def n_intervals(self) -> int:
+        return max(0, len(self.times) - 1)
+
+    def img_per_sec(self, global_batch: int) -> float | None:
+        if self.n_intervals < 1:
+            return None
+        dt = (self.times[-1] - self.times[0]) / self.n_intervals
+        return global_batch / dt
+
+    def ms_per_step(self) -> float | None:
+        if self.n_intervals < 1:
+            return None
+        return (self.times[-1] - self.times[0]) / self.n_intervals * 1e3

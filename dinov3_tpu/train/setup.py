@@ -41,6 +41,39 @@ from dinov3_tpu.train.train_step import TrainState, make_train_step
 
 
 @dataclasses.dataclass
+class TelemetryPlan:
+    """The async-metrics engine for one training setup: the jitted
+    telemetry step (metrics row -> donated on-device ring, no host
+    sync), the host-side column order, and the ring constructor.
+
+    Built LAZILY (``TrainSetup.telemetry()``) because deriving the
+    metric column order costs one extra ``eval_shape`` trace of the
+    step — the hot loop and bench pay it once; setups whose callers
+    only use ``step_fn`` (most tests) pay nothing.
+    """
+
+    step_fn: Callable      # (state, ring, batch, scalars, rng) -> (state, ring)
+    metric_names: list     # ring column order (sorted metric keys)
+    ring_len: int          # K = telemetry.flush_every
+    ring_shardings: Any    # replicated NamedShardings for the RingState
+
+    def init_ring(self):
+        """Fresh zeroed device ring (donated to the step thereafter)."""
+        from dinov3_tpu.telemetry.ring import make_ring
+
+        return jax.device_put(
+            make_ring(len(self.metric_names), self.ring_len),
+            self.ring_shardings,
+        )
+
+    def reader(self, start_iteration: int = 0):
+        from dinov3_tpu.telemetry.ring import RingReader
+
+        return RingReader(self.metric_names, self.ring_len,
+                          start_iteration=start_iteration)
+
+
+@dataclasses.dataclass
 class TrainSetup:
     cfg: ConfigNode
     meta: SSLMetaArch
@@ -53,6 +86,10 @@ class TrainSetup:
     batch_shardings: dict
     fused_update: Callable | None = None  # single-pass engine, None = optax chain
     sharded_update: bool = False  # cross-replica sharded form of the engine
+    # lazy TelemetryPlan builder; None = telemetry.async_metrics=false
+    # (the per-step-fetch oracle path is then the only metrics path)
+    telemetry_builder: Callable | None = None
+    _telemetry_cache: Any = dataclasses.field(default=None, repr=False)
 
     def scalars(self, iteration: int) -> dict:
         s = self.schedules.at(iteration)
@@ -61,6 +98,15 @@ class TrainSetup:
             "momentum": jnp.asarray(s["momentum"], jnp.float32),
         }
 
+    def telemetry(self) -> TelemetryPlan | None:
+        """The async-metrics engine (built on first use), or None when
+        the config selects the per-step-fetch oracle."""
+        if self.telemetry_builder is None:
+            return None
+        if self._telemetry_cache is None:
+            self._telemetry_cache = self.telemetry_builder()
+        return self._telemetry_cache
+
 
 def build_train_setup(
     cfg: ConfigNode,
@@ -68,8 +114,17 @@ def build_train_setup(
     rng: jax.Array | None = None,
     devices=None,
     mesh=None,
+    init_state: bool = True,
 ) -> TrainSetup:
-    """Build everything needed to train, with state born sharded."""
+    """Build everything needed to train, with state born sharded.
+
+    ``init_state=False`` returns the setup with ``state`` as UNBOXED
+    ``ShapeDtypeStruct``s instead of materialized device arrays — the
+    compile-only form the memory-accounting dryrun uses
+    (scripts/cost_host_sync.py lowers the jitted step from the abstract
+    state at ViT-L dp=8 without holding 8 replicated ViT-L trees in
+    host RAM). Such a setup can ``.lower(...).compile()`` but not
+    execute."""
     rng = rng if rng is not None else jax.random.key(cfg.train.seed)
     mesh = mesh if mesh is not None else build_mesh(
         MeshSpec.from_cfg(cfg.parallel), devices=devices
@@ -179,11 +234,15 @@ def build_train_setup(
 
     import flax.linen as nn
 
-    init_jit = jax.jit(
-        lambda r: nn.meta.unbox(boxed_init(r)), out_shardings=state_shardings
-    )
-    with mesh:
-        state = init_jit(rng)
+    if init_state:
+        init_jit = jax.jit(
+            lambda r: nn.meta.unbox(boxed_init(r)),
+            out_shardings=state_shardings,
+        )
+        with mesh:
+            state = init_jit(rng)
+    else:
+        state = nn.meta.unbox(abstract)
 
     b_shardings = batch_specs(mesh, example_batch)
     raw_step = make_train_step(
@@ -206,11 +265,54 @@ def build_train_setup(
         # utils.donation_safe_argnums)
         donate_argnums=donation_safe_argnums((0,)),
     )
+
+    # async metrics ring (telemetry/, auto=on; the per-step-fetch oracle
+    # stays behind telemetry.async_metrics=false). Lazy: the builder
+    # traces the raw step once (eval_shape) to fix the ring's column
+    # order, so only callers that USE the engine (the hot loop, bench,
+    # the telemetry tests) pay the extra trace.
+    from dinov3_tpu.telemetry import telemetry_wished
+
+    telemetry_builder = None
+    if telemetry_wished(cfg):
+        tele_cfg = cfg.get("telemetry") or {}
+
+        def _build_telemetry() -> TelemetryPlan:
+            from dinov3_tpu.telemetry.ring import make_ring
+            from dinov3_tpu.train.train_step import make_telemetry_step
+
+            abstract_scalars = {
+                "teacher_temp": jax.ShapeDtypeStruct((), jnp.float32),
+                "momentum": jax.ShapeDtypeStruct((), jnp.float32),
+            }
+            abs_metrics = jax.eval_shape(
+                raw_step, nn.meta.unbox(abstract), example_batch,
+                abstract_scalars, jax.random.key(0),
+            )[1]
+            names = sorted(abs_metrics)
+            ring_len = int(tele_cfg.get("flush_every", 50))
+            ring_shardings = jax.tree.map(
+                lambda _: rep, make_ring(len(names), ring_len))
+            t_step = jax.jit(
+                make_telemetry_step(raw_step, names),
+                in_shardings=(state_shardings, ring_shardings, b_shardings,
+                              scalar_shardings, rep),
+                out_shardings=(state_shardings, ring_shardings),
+                # state AND ring donated: the ring write is in-place
+                donate_argnums=donation_safe_argnums((0, 1)),
+            )
+            return TelemetryPlan(
+                step_fn=t_step, metric_names=names, ring_len=ring_len,
+                ring_shardings=ring_shardings,
+            )
+
+        telemetry_builder = _build_telemetry
+
     return TrainSetup(
         cfg=cfg, meta=meta, mesh=mesh, schedules=schedules,
         optimizer=optimizer, state=state, state_shardings=state_shardings,
         step_fn=step_fn, batch_shardings=b_shardings, fused_update=fused,
-        sharded_update=use_sharded,
+        sharded_update=use_sharded, telemetry_builder=telemetry_builder,
     )
 
 

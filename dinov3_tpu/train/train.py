@@ -14,9 +14,22 @@ Differences from the reference loop (all SURVEY.md §7.1 by design):
 - schedules indexed in-graph; only teacher_temp/momentum cross the host
   boundary per step (as replicated scalars);
 - async orbax checkpointing with working retention (§2.9.3);
-- NaN watchdog preserved (>2 consecutive non-finite losses aborts);
+- NaN watchdog preserved (>2 consecutive non-finite losses aborts; under
+  async metrics the streak counts on device and the abort lands at the
+  next flush — flush-granularity latency, never a missed abort);
 - optional jax.profiler trace window (the reference stopped a trace it
-  never started, §5.1).
+  never started, §5.1), folded into the phase-span tracer.
+
+Metrics delivery (telemetry/, PR 6): by default the jitted step writes
+its scalar metrics into a donated on-device ring and the host issues ONE
+blocking device->host fetch per ``telemetry.flush_every`` steps; the
+pre-PR-6 per-step ``float(v)`` fetch — which fenced dispatch every step
+— stays as the oracle behind ``telemetry.async_metrics=false``. The
+hot loop's host phases (data-wait, h2d, dispatch, flush, gram, eval,
+checkpoint) are span-traced to JSONL with a per-process heartbeat file
+(mtime = liveness), and per-device memory is sampled at flushes and
+setup/compile boundaries (COST_HSYNC_r11.json / MEM_r11.json are the
+committed accounting).
 """
 
 from __future__ import annotations
@@ -248,6 +261,7 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         a, b = (int(x) for x in args.profile_steps.split(","))
         prof = (a, b)
 
+    from dinov3_tpu.telemetry import SpanTracer, StepTimer, blocking_fetch
     from dinov3_tpu.utils import (
         LossComparator,
         LossRecorder,
@@ -267,7 +281,6 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     comparator = (LossComparator(args.ref_losses)
                   if args.ref_losses and main_here else None)
     bench_n = max(0, int(args.benchmark))
-    step_times: list = []
 
     metric_logger = MetricLogger(
         output_file=f"{cfg.train.output_dir}/training_metrics.json"
@@ -275,6 +288,22 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         tensorboard_dir=f"{cfg.train.output_dir}/tb"
         if (args.tensorboard and main_here) else None,
     )
+
+    # telemetry engine (telemetry/): async metrics ring (None = the
+    # per-step-fetch oracle behind telemetry.async_metrics=false),
+    # phase-span tracer + per-process heartbeat, memory sampling
+    tele_cfg = cfg.get("telemetry") or {}
+    plan = setup.telemetry()
+    tracer = SpanTracer(
+        cfg.train.output_dir, rank=rank,
+        enabled=bool(tele_cfg.get("spans", True)),
+        heartbeat_every=int(tele_cfg.get("heartbeat_every", 1)),
+        profile_steps=prof, profile_dir=f"{cfg.train.output_dir}/trace",
+    )
+    memory_on = bool(tele_cfg.get("memory", True)) and tracer.enabled
+    if memory_on:
+        tracer.emit_memory("setup")
+
     rng = jax.random.key(cfg.train.seed + 1)
     nan_streak = 0
     last_loss = math.nan
@@ -292,67 +321,132 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
 
     preemption = PreemptionHandler().__enter__()
 
+    ring = plan.init_ring() if plan is not None else None
+    reader = plan.reader(start_iteration=start_iter) if plan is not None \
+        else None
+    timer = StepTimer(bench_n, total_iters)
+    compile_sampled = False
+
+    def _sched_row(i: int) -> dict:
+        s = setup.schedules.at(i)
+        return {"lr": s["lr"], "wd": s["weight_decay"],
+                "mom": s["momentum"], "teacher_temp": s["teacher_temp"]}
+
+    def flush_ring(upto: int) -> None:
+        """One blocking fetch of the ring; replay the rows into every
+        per-step consumer (meters, recorder, comparator), then enforce
+        the 3-strike non-finite abort from the device-side streak."""
+        nonlocal last_loss
+        with tracer.span("metrics_flush", upto - 1):
+            its_arr, rows, streak = reader.flush(ring, upto)
+        if not len(its_arr):
+            return
+        loss_col = plan.metric_names.index("total_loss")
+        for j, row_it in enumerate(its_arr):
+            if not math.isfinite(rows[j][loss_col]):
+                logger.warning("non-finite loss at iteration %d", row_it)
+        if recorder is not None:
+            recorder.record_batch(its_arr, plan.metric_names, rows)
+        if comparator is not None:
+            comparator.check_batch(its_arr, plan.metric_names, rows)
+        metric_logger.consume_flush(
+            plan.metric_names, its_arr, rows, scheds=_sched_row)
+        last_loss = float(rows[-1][loss_col])
+        if memory_on:
+            tracer.emit_memory("flush", int(its_arr[-1]))
+        if streak > 2:
+            ckpt.close()
+            tracer.close()
+            raise RuntimeError(
+                f"aborting: {streak} consecutive non-finite losses"
+            )
+
     pending = put_batch(first, setup.batch_shardings)
     for it, raw in metric_logger.log_every(
-        data_iter, print_freq=10, header=header,
+        tracer.wrap_iter(data_iter, start_iteration=start_iter),
+        print_freq=10, header=header,
         n_iterations=total_iters, start_iteration=start_iter,
     ):
         batch = pending
-        # overlap next batch's host->device transfer with this step
-        if prof and it == prof[0]:
-            jax.profiler.start_trace(f"{cfg.train.output_dir}/trace")
-        state, metrics = setup.step_fn(state, batch, setup.scalars(it), rng)
-        pending = put_batch(raw, setup.batch_shardings)
+        tracer.profile_step_begin(it)
+        with tracer.span("dispatch", it):
+            if plan is not None:
+                # async path: metrics land in the donated device ring,
+                # nothing crosses to the host — dispatch never fences
+                state, ring = plan.step_fn(
+                    state, ring, batch, setup.scalars(it), rng)
+            else:
+                state, metrics = setup.step_fn(
+                    state, batch, setup.scalars(it), rng)
+        with tracer.span("h2d", it):
+            # overlap next batch's host->device transfer with this step
+            pending = put_batch(raw, setup.batch_shardings)
+        if memory_on and not compile_sampled:
+            # the first dispatch returned, so the step has compiled
+            tracer.emit_memory("compile", it)
+            compile_sampled = True
 
-        # host-side schedule values for the log line; one device->host
-        # fetch of the metrics, shared by every consumer below
-        sched = setup.schedules.at(it)
-        host_metrics = {k: float(v) for k, v in metrics.items()}
-        last_loss = host_metrics["total_loss"]
-        if recorder is not None:
-            recorder.record(it, host_metrics)
-        if comparator is not None:
-            comparator.check(it, host_metrics)
-        if bench_n and it >= total_iters - bench_n - 1:
-            # the metrics fetch above synced, so the step has completed;
-            # one extra leading timestamp gives N measured intervals
-            step_times.append(time.perf_counter())
-        if not math.isfinite(last_loss):
-            nan_streak += 1
-            logger.warning("non-finite loss at iteration %d", it)
-            if nan_streak > 2:
-                ckpt.close()
-                raise RuntimeError(
-                    f"aborting: {nan_streak} consecutive non-finite losses"
-                )
-        else:
-            nan_streak = 0
-        metric_logger.update(
-            lr=sched["lr"], wd=sched["weight_decay"], mom=sched["momentum"],
-            teacher_temp=sched["teacher_temp"],
-            **host_metrics,
-        )
-        if prof and it == prof[1]:
-            jax.tree.leaves(state.params)[0].block_until_ready()
-            jax.profiler.stop_trace()
+        if plan is None:
+            # oracle path (telemetry.async_metrics=false): ONE blocking
+            # device->host fetch of the metrics dict per step, shared by
+            # every consumer below — this fences dispatch every step,
+            # which is exactly what COST_HSYNC_r11.json prices
+            sched = setup.schedules.at(it)
+            with tracer.span("metrics_fetch", it):
+                host_metrics = {
+                    k: float(v)
+                    for k, v in blocking_fetch(metrics).items()
+                }
+            last_loss = host_metrics["total_loss"]
+            if recorder is not None:
+                recorder.record(it, host_metrics)
+            if comparator is not None:
+                comparator.check(it, host_metrics)
+            if not math.isfinite(last_loss):
+                nan_streak += 1
+                logger.warning("non-finite loss at iteration %d", it)
+                if nan_streak > 2:
+                    ckpt.close()
+                    tracer.close()
+                    raise RuntimeError(
+                        f"aborting: {nan_streak} consecutive non-finite "
+                        "losses"
+                    )
+            else:
+                nan_streak = 0
+            metric_logger.update(
+                lr=sched["lr"], wd=sched["weight_decay"],
+                mom=sched["momentum"], teacher_temp=sched["teacher_temp"],
+                **host_metrics,
+            )
+        if timer.active(it):
+            # --benchmark fences EXPLICITLY (one tiny value fetch per
+            # timed step) instead of free-riding on the per-step metrics
+            # fetch the async path removes; one extra leading mark gives
+            # N measured intervals (telemetry/spans.py StepTimer)
+            timer.mark(state)
+        tracer.profile_step_end(it, state)
         if "gram" in state.params and should_refresh_gram(
             cfg, it, n_gram_updates
         ):
-            state = refresh_gram(state)
+            with tracer.span("gram_refresh", it):
+                state = refresh_gram(state)
             n_gram_updates += 1
         eval_period = cfg.evaluation.get("eval_period_iterations", 0)
         if eval_period and (it + 1) % eval_period == 0:
             from dinov3_tpu.evals import do_eval
 
-            results = do_eval(
-                cfg, setup.meta.teacher_backbone,
-                state.params["teacher"]["backbone"],
-                # subgroup-safe: shard eval data by the group's rank span
-                # and gather features over the group's devices only
-                # (ADVICE r2 — a global collective here deadlocks
-                # multidistillation groups with different schedules)
-                data_rank=rank, data_world=world, mesh=setup.mesh,
-            )
+            with tracer.span("eval", it):
+                results = do_eval(
+                    cfg, setup.meta.teacher_backbone,
+                    state.params["teacher"]["backbone"],
+                    # subgroup-safe: shard eval data by the group's rank
+                    # span and gather features over the group's devices
+                    # only (ADVICE r2 — a global collective here
+                    # deadlocks multidistillation groups with different
+                    # schedules)
+                    data_rank=rank, data_world=world, mesh=setup.mesh,
+                )
             metric_logger.update(**results)
             if rank == 0:
                 # one clean record per eval (the meter JSONL smooths
@@ -364,21 +458,33 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
                     f.write(_json.dumps(
                         {"iteration": it + 1, **results}) + "\n")
         stopping = preemption.should_stop()
+        if plan is not None and (
+            it + 1 - reader.cursor >= plan.ring_len
+            or it + 1 >= total_iters
+            or stopping
+        ):
+            # flush BEFORE the checkpoint/exit decision so the recorded
+            # metrics are durable when a preemption (or the abort) ends
+            # the run here
+            flush_ring(it + 1)
         if (
             (it + 1) % cfg.checkpointing.period == 0
             or it + 1 == total_iters
             or stopping
         ):
-            ckpt.save(it + 1, state)
+            with tracer.span("checkpoint_save", it):
+                ckpt.save(it + 1, state)
         if stopping:
             logger.warning("preempted: checkpointed at iteration %d, "
                            "exiting for requeue", it + 1)
             break
         if it + 1 >= total_iters:
             break
+        tracer.beat(it)
 
     preemption.__exit__()
     metric_logger.close()
+    tracer.close()
     ckpt.close()
     result = {"final_loss": last_loss, "iterations": int(state.step)}
     if recorder is not None:
@@ -387,11 +493,10 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     if comparator is not None:
         logger.info("loss comparison: %s", comparator.summary())
         result["loss_divergences"] = comparator.n_diverged
-    if len(step_times) >= 2:
-        dt = (step_times[-1] - step_times[0]) / (len(step_times) - 1)
-        img_s = B / dt
+    if timer.n_intervals >= 1:
+        img_s = timer.img_per_sec(B)
         logger.info("benchmark: %.1f ms/step, %.1f img/s (%d devices)",
-                    dt * 1e3, img_s, n_devices)
+                    timer.ms_per_step(), img_s, n_devices)
         result["img_per_sec"] = img_s
     if args.dump_weights:
         from dinov3_tpu.utils import dump_weights

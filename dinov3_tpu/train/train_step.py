@@ -31,6 +31,13 @@ default — a few large fused draws consumed as static slices) and the
 legacy per-consumer fold_in chains behind ``rng.plan=false`` (the test
 oracle). Both derive from ``fold_in(base, iteration)``, so draws at
 iteration k are identical on resume either way.
+
+Metrics delivery has two implementations too (telemetry/, PR 6): the
+async path wraps this step with ``make_telemetry_step`` — the metrics
+row lands in a donated on-device ring via one dynamic-update-slice,
+nothing crosses to the host per step — while the oracle
+(``telemetry.async_metrics=false``) returns the metrics dict for the
+hot loop's per-step ``float(v)`` fetch, exactly as before.
 """
 
 from __future__ import annotations
@@ -145,3 +152,31 @@ def make_train_step(
         return new_state, metrics
 
     return step
+
+
+def make_telemetry_step(step: Callable, metric_names) -> Callable:
+    """Wrap a ``step(state, batch, scalars, rng) -> (state, metrics)``
+    into the async-telemetry form ``(state, ring, batch, scalars, rng)
+    -> (state, ring)``.
+
+    The metrics dict never becomes a program output: its scalars are
+    stacked into one f32 row and written into the donated ring at slot
+    ``state.step % K`` (telemetry/ring.py write_row — one
+    dynamic-update-slice under the ``telemetry_ring`` named scope, so
+    the copy census attributes it), and the device-side non-finite
+    streak scalar is advanced from ``total_loss``. ``metric_names``
+    fixes the column order (the host reader interprets columns by it);
+    setup derives it from an ``eval_shape`` of the raw step so the two
+    can never drift.
+    """
+    from dinov3_tpu.telemetry.ring import write_row
+
+    names = list(metric_names)
+
+    def telemetry_step(state: TrainState, ring, batch: dict, scalars: dict,
+                       rng: jax.Array):
+        it = state.step  # pre-increment iteration stamps the row
+        new_state, metrics = step(state, batch, scalars, rng)
+        return new_state, write_row(ring, it, metrics, names)
+
+    return telemetry_step

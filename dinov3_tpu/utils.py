@@ -55,6 +55,15 @@ class LossRecorder:
         self._f.write(json.dumps(row) + "\n")
         self._f.flush()
 
+    def record_batch(self, iterations, names, rows) -> None:
+        """Record one flushed telemetry batch: ``rows[j]`` is the
+        ``[M]`` metric vector of ``iterations[j]`` with ``names`` as
+        column order (telemetry/ring.py RingReader.flush) — the exact
+        per-step values the ring stored, so ``--record-losses`` traces
+        are identical under async metrics and the per-step oracle."""
+        for it, row in zip(iterations, rows):
+            self.record(int(it), dict(zip(names, row)))
+
     def close(self) -> None:
         self._f.close()
 
@@ -96,6 +105,15 @@ class LossComparator:
             if err > self.worst[0]:
                 self.worst = (err, key, iteration)
         self.n_diverged += not ok
+        return ok
+
+    def check_batch(self, iterations, names, rows) -> bool:
+        """Check one flushed telemetry batch (see
+        ``LossRecorder.record_batch``); returns whether EVERY row
+        matched, logging divergences row by row as ``check`` does."""
+        ok = True
+        for it, row in zip(iterations, rows):
+            ok = self.check(int(it), dict(zip(names, row))) and ok
         return ok
 
     def summary(self) -> str:
@@ -269,6 +287,12 @@ def classify_copy(line: str) -> str:
       train/fused_update.py make_sharded_update) — the leaf-layout
       traffic the cross-replica sharding introduces, named for the same
       reason.
+    - "telemetry": the async metrics ring's in-place row writes (the
+      ``telemetry_ring`` named scope in telemetry/ring.py write_row —
+      one [1, M] metrics-row and one [1] iteration-stamp
+      dynamic-update-slice per step), attributed so the telemetry
+      step's census ceiling names its own cost instead of absorbing it
+      into "small" (tests/test_telemetry.py pins the ceiling).
     - "rng": u32 results of <= 8 elements — threefry key/counter
       plumbing (keys are u32[2]/u32[4]; fold_in intermediates scalar).
     - "small": any other result of <= 1024 elements (scalar metrics,
@@ -282,6 +306,8 @@ def classify_copy(line: str) -> str:
         return "gather_pack"
     if "update_shard_pack" in line or "update_shard_unpack" in line:
         return "update_shard"
+    if "telemetry_ring" in line:
+        return "telemetry"
     shp = _hlo_result_shape(line)
     if shp is None:
         return "small"

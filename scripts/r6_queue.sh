@@ -57,6 +57,20 @@
 #         per-device update-phase weight-shaped bytes at dp=8 ViT-L,
 #         RS+AG census with zero unattributed collectives; this
 #         measures what the TPU scheduler does with each form.
+#   phO   async telemetry engine A/B (the per-step host-sync attack,
+#         telemetry/ring.py): default program (telemetry.async_metrics
+#         auto=on — metrics row into a donated on-device ring, no
+#         per-step device->host fetch) vs =false per-step-fetch oracle
+#         control, same session, both arms pinned BENCH_PROBS=bf16 AND
+#         BENCH_CENSUS=1 (the r5b phT pinned-arm lesson) so each
+#         record embeds the copy census with the new "telemetry"
+#         attribution category next to the throughput delta. Host-side
+#         accounting (scripts/cost_host_sync.py, COST_HSYNC_r11.json):
+#         the real hot loop issues 1 blocking fetch per
+#         telemetry.flush_every steps vs 1 per step; every bench
+#         record also embeds its own measure-loop fetch count +
+#         host-blocked ms ("telemetry" field). This measures what the
+#         TPU dispatch pipeline does with each form.
 #   phG2  fixed op-level flash-vs-dense attention crossover
 #         (scripts/crossover_attention.py): the
 #         kernels.flash_min_seq=2048 boundary is measured only at
@@ -202,6 +216,18 @@ run_bench phP_packed_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
 run_bench phZ_sharded_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1
 run_bench phZ_sharded_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=optim.sharded_update=false
+
+# phO: async telemetry engine A/B. Treatment = the committed default
+# program (telemetry.async_metrics auto = on; bench.py benches the
+# telemetry step — ring write in-graph, one fetch per measure loop);
+# control strips ONLY the engine (per-step-fetch-oracle program; note
+# bench's measure loop itself never fetched per step, so the control
+# isolates the ring write + donation cost while COST_HSYNC_r11.json
+# carries the hot-loop fetch-count story). Both arms embed the copy
+# census so the "telemetry" category lands next to the throughput.
+run_bench phO_telemetry_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1
+run_bench phO_telemetry_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=telemetry.async_metrics=false
 
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including
