@@ -348,6 +348,29 @@ def build_step_overrides(arch: str, res: int, *,
     return overrides + list(extra)
 
 
+def _zero3_summary(setup, coll_census) -> dict:
+    """The record's "zero3" block: arm, per-device master/state bytes
+    from the assigned NamedShardings, and (census runs only) the
+    engine-scoped all-gather counts of the benched program."""
+    from dinov3_tpu.telemetry.memory import layout_split
+
+    masters = layout_split(setup.state.params, setup.state_shardings.params)
+    state = layout_split(setup.state, setup.state_shardings)
+    out = {
+        "arm": bool(setup.zero3),
+        "master_bytes_per_device": masters["per_device_bytes"],
+        "master_replicated_fraction": round(
+            masters["replicated_fraction"], 4),
+        "state_bytes_per_device": state["per_device_bytes"],
+    }
+    if coll_census and "by_scope" in coll_census:
+        out["gathers_by_scope"] = {
+            k: v for k, v in coll_census["by_scope"].items()
+            if k.startswith("zero3")}
+        out["prefetch_overlap"] = coll_census.get("prefetch_overlap")
+    return out
+
+
 _CURRENT_CHILD = {"proc": None}
 
 
@@ -630,6 +653,10 @@ def main():
         setup = build_train_setup(cfg, batch)
     pad_warnings = [str(w.message) for w in _bcaught
                     if "sharded-update flat master axis" in str(w.message)]
+    # ... and the zero3 layout guardrail (configs/config.py
+    # warn_zero3_padding), same capture pattern
+    zero3_warnings = [str(w.message) for w in _bcaught
+                      if "zero3 master layout" in str(w.message)]
     dbatch = put_batch(batch, setup.batch_shardings)
     rng = jax.random.key(0)
     state = setup.state
@@ -751,6 +778,12 @@ def main():
             "memory": {"setup": mem_setup, "compile": mem_compile,
                        "measure": mem_measure},
         },
+        # zero3 summary: which master-layout arm was benched, its
+        # per-device state footprint from the assigned shardings
+        # (telemetry/memory.layout_split — the phW A/B reads the
+        # masters story straight from here), and — when the census ran —
+        # the engine-scoped gather counts of the exact benched program
+        "zero3": _zero3_summary(setup, coll_census),
     }
     if census is not None:
         rec["copy_census"] = census
@@ -760,6 +793,8 @@ def main():
         rec["batch_tiling_warning"] = tiling_warning
     if pad_warnings:
         rec["update_shard_padding_warning"] = "; ".join(pad_warnings)
+    if zero3_warnings:
+        rec["zero3_padding_warning"] = "; ".join(zero3_warnings)
     if degraded:
         # distinct reasons can fire for the global- and local-crop
         # batches of the same program — keep them all

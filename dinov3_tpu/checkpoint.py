@@ -294,6 +294,16 @@ class Checkpointer:
         ``state_like``'s placement. The adapting path stages the moments
         addressably before re-placing them, so it is a single-host
         convenience; same-arm restores keep the direct sharded path.
+
+        The ZeRO-3 arm (``parallel.zero3``) keeps every leaf in its
+        MODEL shape — only the ``NamedSharding`` placement differs — so
+        replicated <-> zero3 restores are pure re-placements (orbax
+        restores each leaf straight into ``state_like``'s sharding; the
+        local-npz backend ``device_put``s per leaf) and need no shape
+        adaptation at all; flat-sharded-update <-> zero3 crossings ride
+        the same ``_adapt_opt_leaf`` flat/full path as flat <->
+        replicated. Round-trips and resume determinism across all three
+        arms are pinned in tests/test_zero3.py.
         """
         step = step if step is not None else self.latest_step()
         if step is None:

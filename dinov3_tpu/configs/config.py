@@ -187,6 +187,9 @@ def load_config(
     # wider than the checkpoint/eval cadence silently loses exactly the
     # rows around the events one most wants recorded
     warn_telemetry_flush_period(cfg)
+    # ... and over the zero3/scan combination: sharded block weights
+    # with no scan loop to stream them through
+    warn_zero3_no_stream(cfg)
     return cfg
 
 
@@ -314,6 +317,92 @@ def warn_student_row_tiling(
         if m:
             msgs.append(m)
     return msgs
+
+
+def zero3_wished(cfg: ConfigNode) -> bool:
+    """Whether the config ASKS for the ZeRO-3 weight-streaming engine
+    (before the setup-time data-axis-size > 1 check).
+
+    ``parallel.zero3``: auto (default) = on when ``parallel.fsdp > 1``
+    (an fsdp axis is an explicit request for parameter sharding — zero3
+    is how this repo provides it); true = on whenever the data-axis
+    product is > 1 (pure data-parallel meshes shard their masters too);
+    false = the replicated-masters oracle."""
+    par = cfg.get("parallel") or {}
+    z = par.get("zero3", "auto")
+    if isinstance(z, str):
+        zl = z.lower()
+        if zl == "auto":
+            return int(par.get("fsdp", 1) or 1) > 1
+        return zl in ("true", "on", "1")
+    return bool(z)
+
+
+def zero3_stream_wished(cfg: ConfigNode) -> bool:
+    """Whether the per-block weight stream (scoped bf16 gathers inside
+    the block scan, ops/block.py) should engage: zero3 is wished AND the
+    config is model-parallel-free — the stream's materialization
+    constraint replicates a block's weights for compute, which would
+    also undo a tensor/expert/seq split. Model-parallel zero3 configs
+    still run (masters sharded, GSPMD places the gathers), just without
+    the scoped stream."""
+    if not zero3_wished(cfg):
+        return False
+    par = cfg.get("parallel") or {}
+    return all(
+        int(par.get(a, 1) or 1) <= 1
+        for a in ("tensor", "seq", "pipe", "expert")
+    )
+
+
+def warn_zero3_padding(
+    waste: float, dp: int, threshold: float = 0.01, stacklevel: int = 2,
+) -> str | None:
+    """Warn when the zero3 master layout leaves > ``threshold`` of the
+    master elements replicated — leaves where no free dimension divides
+    the shard count ``dp`` (parallel/sharding.py zero3_replicated_waste),
+    the layout's per-device overhead over a perfect 1/dp split and the
+    analogue of the flat update engine's ``warn_update_shard_padding``.
+    Fired at training-setup build (train/setup.py, where the leaf shapes
+    and the mesh first coexist) and recorded by ``bench.py``; returns
+    the message, or None when the overhead is negligible."""
+    if waste <= threshold:
+        return None
+    msg = (
+        f"zero3 master layout: {waste:.1%} of the master elements have "
+        f"no dimension divisible by the shard count dp={dp} and stay "
+        f"replicated on every device (> {threshold:.0%}) — the "
+        f"per-device state saving degrades by that fraction "
+        f"(parallel/sharding.py zero3_leaf_spec). Pick a shard count "
+        f"that divides the model dims, or set parallel.zero3=false."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
+
+
+def warn_zero3_no_stream(cfg: ConfigNode, stacklevel: int = 2) -> str | None:
+    """Warn when zero3 is wished but ``train.scan_layers`` is false —
+    the block weights are still sharded and gathered at use, but there
+    is no scan loop to stream them through, so every block's gather sits
+    in the flat unrolled graph with nothing to overlap (the
+    double-buffered prefetch story needs the loop). Fired at config
+    build (``load_config``)."""
+    if not zero3_wished(cfg) or bool(cfg.train.get("scan_layers", False)):
+        return None
+    msg = (
+        "parallel.zero3 is on but train.scan_layers=false: block "
+        "weights are sharded but there is no block scan to stream them "
+        "through — the per-block all-gathers land in the unrolled "
+        "graph with no loop to overlap prefetch against. Set "
+        "train.scan_layers=true (the zero3 configs do) or "
+        "parallel.zero3=false."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
 
 
 def update_shard_padding_waste(leaf_sizes, dp: int) -> float:

@@ -101,6 +101,17 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
     kw["pipeline_stages"] = int(parallel.get("pipe", 1) or 1)
     kw["pipeline_microbatches"] = int(parallel.get("pipe_microbatches", 0) or 0)
     kw["scan_layers"] = bool(train.get("scan_layers", False))
+    # ZeRO-3 per-block weight stream (ops/block.py): gather each block's
+    # sharded weights inside the block stack under the ``zero3_stream``
+    # named scope, the matmul weights cast to compute dtype BEFORE the
+    # gather (halves the streamed bytes; bitwise-identical because the
+    # modules cast at use anyway). Engages only for model-parallel-free
+    # zero3 configs (the materialization constraint would undo a
+    # tensor/expert split), and never pre-casts under fp8 (the fp8
+    # quantizer must see the original fp32 weights).
+    from dinov3_tpu.configs.config import zero3_stream_wished
+
+    kw["zero3_stream"] = zero3_stream_wished(cfg)
     # fp8 projections inside blocks when the filter regex matches "blocks"
     # (reference config surface: student.fp8_enabled / fp8_filter,
     # ssl_default_config.yaml:121-122). Student only: the EMA teacher's

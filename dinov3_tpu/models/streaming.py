@@ -1,0 +1,139 @@
+"""Explicit double-buffered ZeRO-3 weight stream over a block stack —
+the census/schedule twin of the GSPMD streaming engine.
+
+The default engine (parallel.zero3, train/setup.py) expresses weight
+streaming through sharding annotations: the scanned block stack enters
+``nn.scan`` sharded over the data axes and each block's weights are
+all-gathered inside the compiled while body at use (ops/block.py
+``_zero3_stream_trans_in``). WHERE the partitioner places those gathers
+relative to the consuming block's compute — and whether the gather of
+block i+1 overlaps block i — is then the backend scheduler's decision,
+invisible in the annotation-level program.
+
+``streamed_block_scan`` below is the same schedule written EXPLICITLY,
+the convention ``make_sharded_update_schedule`` established for the
+sharded update engine: a ``lax.scan`` whose carry holds the NEXT block's
+already-gathered weights — iteration i issues the gather of block i+1
+(named scope ``zero3_prefetch``) before running block i's compute on the
+weights gathered one iteration earlier, so the compiled HLO contains the
+literal double-buffered gather schedule: every in-loop all-gather except
+the priming one is issued a full block of compute ahead of its consumer.
+scripts/cost_zero3.py compiles this program for the committed
+prefetch-overlap census (the ``prefetch_overlap`` columns of
+``utils.hlo_collective_census``), and the stack it streams is the bf16
+pre-cast form (``cast_stream_leaves``), so the census prices the bf16
+stream the engine asks for rather than whatever dtype placement the
+backend's simplifier chose. tests/test_zero3.py pins both its numerics
+(bitwise vs a per-block oracle loop) and its census shape.
+
+Liveness is the double-buffer invariant: exactly TWO gathered block
+weight sets exist at any point of the forward (current + prefetched),
+1/dp of everything else — the "free after use" half of the SimpleFSDP
+pattern falls out of the scan carry being overwritten each iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from dinov3_tpu.ops.block import stream_castable_path
+
+
+def cast_stream_leaves(stack_params: Any, dtype) -> Any:
+    """Cast the bf16-streamable leaves (attn/mlp matmul weights — the
+    shared ``stream_castable_path`` rule) of a stacked block-param tree
+    to the stream dtype, leaving fp32-consumed leaves (norm scales,
+    layerscale, MoE router) untouched. Shard-local and elementwise:
+    applied BEFORE the scan so the loop constant — and therefore every
+    in-loop gather — is in the stream dtype by construction."""
+    import jax.tree_util as jtu
+
+    def leaf(path, p):
+        if (hasattr(p, "dtype") and stream_castable_path(path)
+                and jnp.issubdtype(p.dtype, jnp.floating)):
+            if isinstance(p, jax.ShapeDtypeStruct):
+                # abstract (compile-only accounting) form
+                return jax.ShapeDtypeStruct(p.shape, dtype)
+            return p.astype(dtype)
+        return p
+
+    return jtu.tree_map_with_path(leaf, stack_params)
+
+
+def streamed_block_scan(
+    block_apply: Callable,
+    stack_params: Any,
+    x: jnp.ndarray,
+    n_blocks: int,
+    mesh=None,
+    prefetch: bool = True,
+):
+    """Run ``n_blocks`` blocks over ``x`` with an explicit double-
+    buffered weight stream.
+
+    ``block_apply(block_params, x) -> x``: one block's pure apply (e.g.
+    a bound ``SelfAttentionBlock.apply``). ``stack_params``: pytree of
+    ``[n_blocks, ...]`` leaves, sharded over the data axes on non-layer
+    dims (the zero3 layout — the per-block slice is then shard-local
+    and only the materialization moves bytes). ``prefetch=True`` is the
+    double-buffered schedule (gather i+1 under block i's compute, scope
+    ``zero3_prefetch``); ``prefetch=False`` gathers each block at use
+    (scope ``zero3_stream``) — the A/B control for the overlap census.
+    """
+    if mesh is None:
+        from dinov3_tpu.parallel.context import get_current_mesh
+
+        mesh = get_current_mesh()
+    from dinov3_tpu.parallel.sharding import constrain_replicated
+
+    def gather_block(i, scope):
+        def leaf(p):
+            s = jax.lax.dynamic_index_in_dim(p, i, 0, keepdims=False)
+            return constrain_replicated(s, mesh) if mesh is not None else s
+
+        with jax.named_scope(scope):
+            return jax.tree.map(leaf, stack_params)
+
+    if not prefetch:
+        def body_at_use(x, i):
+            return block_apply(gather_block(i, "zero3_stream"), x), None
+
+        x, _ = jax.lax.scan(body_at_use, x, jnp.arange(n_blocks))
+        return x
+
+    # prime the buffer: block 0's weights gathered before the loop
+    w0 = gather_block(jnp.asarray(0), "zero3_gather")
+
+    def body(carry, i):
+        x, w = carry
+        # issue block i+1's gather BEFORE block i's compute — no data
+        # dependency between them, so the scheduler can run the gather
+        # under the compute (the last iteration re-gathers the final
+        # block into a dead carry slot: one wasted gather per pass, the
+        # price of a static-shape double buffer)
+        w_next = gather_block(
+            jnp.minimum(i + 1, n_blocks - 1), "zero3_prefetch")
+        x = block_apply(w, x)
+        return (x, w_next), None
+
+    (x, _), _ = jax.lax.scan(body, (x, w0), jnp.arange(n_blocks))
+    return x
+
+
+def make_block_apply(block_kwargs: dict, rope=None, seg=None) -> Callable:
+    """A deterministic single-block apply for the streamed scan:
+    ``apply(block_params, x)`` binds ``SelfAttentionBlock`` with the
+    model's own kwargs (pass-granularity convention of the cost
+    scripts: eval-mode, no drop-path randomness)."""
+    from dinov3_tpu.ops.block import SelfAttentionBlock
+
+    block = SelfAttentionBlock(**block_kwargs)
+
+    def apply(block_params, x):
+        return block.apply(
+            {"params": block_params}, x, rope, True, None, seg)
+
+    return apply

@@ -56,11 +56,16 @@ class _CollectScanBlock(nn.Module):
     block_kwargs: dict
     collect_idx: tuple  # static, sorted
     remat: str = "none"
+    zero3_stream: bool = False
+    stream_dtype: Any = None
 
     @nn.compact
     def __call__(self, carry, i, dp_plan, rope, deterministic: bool):
         x, buf = carry
-        x = remat_block_cls(self.remat)(
+        x = remat_block_cls(
+            self.remat, self.zero3_stream, self.stream_dtype,
+            stream_init=self.is_initializing(),
+        )(
             **self.block_kwargs, name="block"
         )(x, rope, deterministic, dp_plan)
         hit = (jnp.asarray(self.collect_idx) == i)[:, None, None, None]
@@ -109,6 +114,13 @@ class DinoVisionTransformer(nn.Module):
     fp8: bool = False              # fp8 projections inside blocks
     moe_num_experts: int = 8       # only used when ffn_layer == "moe"
     moe_top_k: int = 2
+    # ZeRO-3 per-block weight stream (ops/block.py remat_block_cls):
+    # materialize each block's sharded weights inside the block stack —
+    # under nn.scan the all-gather sits inside the compiled while body,
+    # matmul weights cast to compute dtype BEFORE the gather. Set from
+    # parallel.zero3 by build_backbone (models/__init__.py); inert
+    # without a sharded mesh.
+    zero3_stream: bool = False
     remat: str = "none"  # none | blocks | full
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -243,6 +255,9 @@ class DinoVisionTransformer(nn.Module):
         to every block like rope (not supported on the pipeline path;
         the meta arch falls back to two passes there)."""
         collected = {}
+        # ZeRO-3 stream: bf16 pre-cast for the matmul weights, unless
+        # fp8 owns the cast point (the quantizer reads the fp32 masters)
+        stream_dtype = None if self.fp8 else self.dtype
         if self.pipeline_stages > 1:
             from dinov3_tpu.parallel.pipeline import PipelinedBlocks
 
@@ -268,7 +283,9 @@ class DinoVisionTransformer(nn.Module):
                          nn.broadcast, nn.broadcast, nn.broadcast),
                 length=self.n_blocks,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(block_kwargs=self._block_kwargs(), remat=self.remat, name="blocks")
+            )(block_kwargs=self._block_kwargs(), remat=self.remat,
+              zero3_stream=self.zero3_stream, stream_dtype=stream_dtype,
+              name="blocks")
             x, _ = scanned(x, plan, rope, deterministic, seg)
         elif self.scan_layers:
             take = tuple(sorted(collect))
@@ -281,7 +298,8 @@ class DinoVisionTransformer(nn.Module):
                 length=self.n_blocks,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(block_kwargs=self._block_kwargs(), collect_idx=take,
-              remat=self.remat, name="blocks")
+              remat=self.remat, zero3_stream=self.zero3_stream,
+              stream_dtype=stream_dtype, name="blocks")
             buf0 = jnp.zeros((len(take),) + x.shape, x.dtype)
             (x, buf), _ = scanned(
                 (x, buf0), jnp.arange(self.n_blocks), plan, rope,
@@ -292,7 +310,10 @@ class DinoVisionTransformer(nn.Module):
             from dinov3_tpu.rng.plan import plan_layer_slice
 
             for i in range(self.n_blocks):
-                x = remat_block_cls(self.remat)(
+                x = remat_block_cls(
+                    self.remat, self.zero3_stream, stream_dtype,
+                    stream_init=self.is_initializing(),
+                )(
                     **self._block_kwargs(), name=f"blocks_{i}"
                 )(x, rope, deterministic, plan_layer_slice(plan, i), seg)
                 if i in collect:

@@ -165,25 +165,120 @@ class SelfAttentionBlock(nn.Module):
             x = x + dp(mlp_branch(x), deterministic=deterministic)
         return x
 
-def remat_block_cls(remat: str):
-    """SelfAttentionBlock, optionally wrapped for rematerialization.
+def stream_castable_path(path) -> bool:
+    """Whether the param leaf at ``path`` may be cast to the compute
+    dtype BEFORE the ZeRO-3 gather without changing numerics: the
+    attn/mlp matmul weights and biases — their modules consume them
+    through ``.astype(compute_dtype)`` at use (ops/attention.py,
+    ops/ffn.py), so an earlier cast is bitwise-neutral. Excluded: norm
+    scales/biases and layerscale gammas (consumed in ``reduce_dtype``)
+    and the MoE router (fp32 routing logits by design). Shared by the
+    in-model stream wrapper and the explicit schedule twin
+    (models/streaming.py), so the two programs cast the same leaf set."""
+    keys = {str(getattr(k, "key", getattr(k, "idx", k))) for k in path}
+    return bool({"attn", "mlp"} & keys) and "router" not in keys
 
-    Modes: "none"; "attn" (save everything except the named fp32 softmax
-    state — recomputed in backward, big HBM saving at long N); "blocks"
-    (save only weight matmuls); "full" (save nothing).
+
+def _zero3_stream_trans_in(stream_dtype, constrain: bool = True):
+    """``nn.map_variables`` trans_in_fn for the ZeRO-3 weight stream.
+
+    Materializes ONE block's sharded weights for compute, inside the
+    block stack (so under ``nn.scan`` the all-gather sits inside the
+    compiled while body, per iteration — the weight stream), under the
+    ``zero3_stream`` named scope the collective census attributes. The
+    matmul weights (attn/mlp leaves; the modules consume them through
+    ``.astype(compute_dtype)`` anyway, so this is bitwise-neutral) are
+    cast to ``stream_dtype`` BEFORE the gather — the bf16 stream, half
+    the gathered bytes of the fp32 masters. fp32-consumed leaves (norm
+    scales/biases, layerscale gammas, the MoE router) gather in their
+    storage dtype. ``stream_dtype=None`` disables the pre-cast (fp8:
+    the quantizer must see the original fp32 weights).
+
+    ``constrain=False`` applies only the cast (no materialization) —
+    kept for callers that want the stream dtype without forcing a
+    placement.
+
+    No-op (constraint-wise) without an active mesh, so the wrapped block
+    stays usable in unsharded tests/eval.
+    """
+    import jax
+    import jax.tree_util as jtu
+
+    def trans(variables):
+        from dinov3_tpu.parallel.context import get_current_mesh
+        from dinov3_tpu.parallel.sharding import constrain_replicated
+
+        mesh = get_current_mesh()
+
+        def leaf(path, p):
+            if not hasattr(p, "dtype"):
+                return p
+            if (stream_dtype is not None
+                    and stream_castable_path(path)
+                    and jnp.issubdtype(p.dtype, jnp.floating)
+                    and p.dtype != stream_dtype):
+                master = p
+                p = p.astype(stream_dtype)
+                if mesh is not None:
+                    # pin the cast output to the MASTER's (sharded)
+                    # placement: without this the replicated constraint
+                    # below back-propagates through the elementwise
+                    # convert and the partitioner inserts the all-gather
+                    # at the slice — moving fp32 master bytes instead of
+                    # the bf16 stream (measured on this backend)
+                    from jax.experimental.shard_alike import shard_alike
+
+                    p, _ = shard_alike(p, master)
+            if not constrain:
+                return p
+            return constrain_replicated(p, mesh) if mesh is not None else p
+
+        with jax.named_scope("zero3_stream"):
+            return jtu.tree_map_with_path(leaf, variables)
+
+    return trans
+
+
+def remat_block_cls(remat: str, zero3_stream: bool = False,
+                    stream_dtype=None, stream_init: bool = False):
+    """SelfAttentionBlock, optionally wrapped for rematerialization and
+    the ZeRO-3 weight stream.
+
+    Remat modes: "none"; "attn" (save everything except the named fp32
+    softmax state — recomputed in backward, big HBM saving at long N);
+    "blocks" (save only weight matmuls); "full" (save nothing).
 
     "attn" only has an effect on the dense XLA attention path — the pallas
     flash kernel and ring attention never materialize the [N, N] probs in
-    the first place (models/__init__.py warns on that combination)."""
+    the first place (models/__init__.py warns on that combination).
+
+    ``zero3_stream``: wrap the block in ``nn.map_variables`` so its
+    (sharded) weights are materialized at use under the ``zero3_stream``
+    scope (``_zero3_stream_trans_in``). The map sits INSIDE the remat
+    wrapper, so under remat the gathered weights are never saved as
+    residuals — the backward re-gathers them (the FSDP discipline:
+    gather twice, store 1/dp). ``stream_init`` must be the module's
+    ``is_initializing()``: during init the wrapper is NOT installed —
+    flax's ``map_variables(init=True)`` stores the *transformed*
+    variables, which would silently round the fp32 masters through the
+    bf16 stream cast at birth (caught by the bitwise equivalence spike);
+    the raw block creates the identical param tree, so init and apply
+    stay structurally interchangeable."""
     import jax
 
     if remat not in ("none", "attn", "blocks", "full"):
         raise ValueError(
             f"unknown remat mode {remat!r}; expected none|attn|blocks|full"
         )
+    base = SelfAttentionBlock
+    if zero3_stream and not stream_init:
+        base = nn.map_variables(
+            SelfAttentionBlock, "params",
+            trans_in_fn=_zero3_stream_trans_in(stream_dtype),
+        )
     if remat == "attn":
         return nn.remat(
-            SelfAttentionBlock,
+            base,
             static_argnums=(3,),
             policy=jax.checkpoint_policies.save_anything_except_these_names(
                 "attn_probs"
@@ -191,12 +286,12 @@ def remat_block_cls(remat: str):
         )
     if remat in ("blocks", "full"):
         return nn.remat(
-            SelfAttentionBlock,
+            base,
             static_argnums=(3,),
             policy=(None if remat == "full"
                     else jax.checkpoint_policies.dots_with_no_batch_dims_saveable),
         )
-    return SelfAttentionBlock
+    return base
 
 
 class ScanBlockAdapter(nn.Module):
@@ -206,14 +301,23 @@ class ScanBlockAdapter(nn.Module):
 
     ``dp_plan`` is this layer's slice of the step-wide RNG plan (scanned
     with ``in_axes=0`` over the stacked [L, ...] plan arrays) or None on
-    the legacy rng path / pipeline stages."""
+    the legacy rng path / pipeline stages.
+
+    ``zero3_stream``/``stream_dtype``: the ZeRO-3 weight stream
+    (``remat_block_cls``) — this layer's sharded weight slice is
+    materialized inside the scan body."""
 
     block_kwargs: dict
     remat: str = "none"
+    zero3_stream: bool = False
+    stream_dtype: Any = None
 
     @nn.compact
     def __call__(self, x, dp_plan, rope, deterministic: bool, seg=None):
-        x = remat_block_cls(self.remat)(
+        x = remat_block_cls(
+            self.remat, self.zero3_stream, self.stream_dtype,
+            stream_init=self.is_initializing(),
+        )(
             **self.block_kwargs, name="block"
         )(x, rope, deterministic, dp_plan, seg)
         return x, None

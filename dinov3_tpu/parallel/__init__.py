@@ -28,6 +28,7 @@ from dinov3_tpu.parallel.ring_attention import (
 from dinov3_tpu.parallel.sharding import (
     DEFAULT_LOGICAL_RULES,
     UPDATE_SHARD_AXES,
+    ZERO3_AXES,
     batch_sharding,
     batch_specs,
     constrain_update_shard,
@@ -35,6 +36,9 @@ from dinov3_tpu.parallel.sharding import (
     replicated,
     state_shardings_from_abstract,
     update_shard_size,
+    zero3_materialize_tree,
+    zero3_shard_size,
+    zero3_shardings_from_abstract,
 )
 
 __all__ = [
@@ -60,4 +64,8 @@ __all__ = [
     "replicated",
     "state_shardings_from_abstract",
     "update_shard_size",
+    "ZERO3_AXES",
+    "zero3_materialize_tree",
+    "zero3_shard_size",
+    "zero3_shardings_from_abstract",
 ]

@@ -69,6 +69,19 @@ DEFAULT_LOGICAL_RULES = (
 # setup-time axis-size product, so the three can never disagree
 UPDATE_SHARD_AXES = ("dcn_data", "data", "fsdp")
 
+# the ZeRO-3 weight-streaming engine (parallel.zero3, train/setup.py)
+# shards the fp32 masters / EMA teacher / adam moments over the same
+# axes the batch and the update shard ride — each replica stores 1/dp
+# of every weight-shaped state leaf and the compute weights are
+# re-materialized (all-gathered) at use
+ZERO3_AXES = UPDATE_SHARD_AXES
+
+# logical dim names that must never carry the zero3 axes: the leading
+# stacked dim of scanned / pipelined / expert-stacked params (sharding
+# the scan dim would turn the per-block dynamic-slice into a full-stack
+# gather OUTSIDE the loop — exactly what weight streaming avoids)
+_ZERO3_STACKED_NAMES = frozenset({"layers", "stages", "experts"})
+
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
@@ -209,6 +222,167 @@ def state_shardings_from_abstract(
     """
     logical_specs = nn.get_partition_spec(abstract_boxed)
     return nn.logical_to_mesh_sharding(logical_specs, mesh, list(rules))
+
+
+# ---------------- ZeRO-3 weight-streaming layout ----------------
+#
+# The zero3 engine (train/setup.py, parallel.zero3) stores every master/
+# teacher/moment leaf in its MODEL shape but sharded over the data axes
+# on one dividing dimension — unlike the flat padded layout of the
+# sharded UPDATE engine ("update_shard" above), which is a step-internal
+# packing. Keeping the model shape is what makes the rest of the system
+# compose: the scanned block stack enters ``lax.scan`` still sharded and
+# each block is all-gathered *inside* the loop at its use (a flat layout
+# would force a pre-loop all-to-all back to model form, hoisting the
+# whole-stack gather out of the scan); checkpoints keep the replicated
+# arm's leaf shapes, so replicated <-> zero3 restores are pure
+# re-placements; and the fused update engine runs unchanged — GSPMD
+# makes its elementwise tree pass shard-local because every input and
+# output leaf carries the same zero3 sharding.
+
+
+def zero3_shard_size(mesh: Mesh | None = None) -> int:
+    """Number of zero3 shards (== ``update_shard_size``: the data-axis
+    product; the two engines split over the same mesh axes)."""
+    return update_shard_size(mesh)
+
+
+def zero3_leaf_spec(
+    shape, names, mesh: Mesh, rules=DEFAULT_LOGICAL_RULES
+):
+    """The zero3 ``PartitionSpec`` for one master leaf, or None when no
+    dimension can carry the data axes (the leaf stays on its
+    logical-rules sharding, i.e. replicated over the data axes).
+
+    Starts from the leaf's logical axis ``names`` (the ``nn.Partitioned``
+    box): stacked dims (``layers``/``stages``/``experts``) and dims
+    mapped to a >1 model-parallel mesh axis by the rules keep their
+    assignment and are skipped; the ``embed`` -> fsdp rule is *subsumed*
+    (zero3 shards over the full data-axis product, fsdp included). The
+    update axes land on the largest remaining dim whose size divides the
+    shard count (ties -> lowest index).
+    """
+    dp = zero3_shard_size(mesh)
+    if dp <= 1 or not shape:
+        return None
+    rule_map = dict(rules)
+    spec: list = [None] * len(shape)
+    free = []
+    for i, d in enumerate(shape):
+        nm = names[i] if names is not None and i < len(names) else None
+        if nm is None:
+            free.append(i)
+            continue
+        if nm in _ZERO3_STACKED_NAMES:
+            mapped = rule_map.get(nm)
+            if mapped is not None and int(mesh.shape.get(mapped, 1)) > 1:
+                spec[i] = mapped
+            continue
+        mapped = rule_map.get(nm)
+        if mapped is None or mapped == "fsdp" or mapped == ("fsdp",):
+            # unmapped or the embed->fsdp ZeRO-3-ish rule: free for zero3
+            free.append(i)
+            continue
+        sizes = mapped if isinstance(mapped, tuple) else (mapped,)
+        if any(int(mesh.shape.get(a, 1)) > 1 for a in sizes):
+            spec[i] = mapped  # model-parallel dim: keep, don't touch
+        else:
+            free.append(i)
+    best = None
+    for i in free:
+        if shape[i] % dp == 0 and (best is None or shape[i] > shape[best]):
+            best = i
+    if best is None:
+        return None
+    from jax.sharding import PartitionSpec as P
+
+    spec[best] = tuple(a for a in ZERO3_AXES if a in mesh.shape)
+    return P(*spec)
+
+
+def zero3_shardings_from_abstract(
+    abstract_boxed: Any, mesh: Mesh, rules=DEFAULT_LOGICAL_RULES
+) -> Any:
+    """NamedSharding tree for a *boxed* master subtree under zero3.
+
+    Each ``nn.Partitioned`` leaf gets ``zero3_leaf_spec``'s placement;
+    leaves without a dividing free dim (and unboxed leaves — step
+    counters) fall back to the logical-rules sharding, exactly what
+    ``state_shardings_from_abstract`` would have produced.
+    """
+
+    def leaf(x):
+        if isinstance(x, nn.Partitioned):
+            shape, names = x.value.shape, x.names
+        else:
+            shape, names = x.shape, (None,) * len(x.shape)
+        spec = zero3_leaf_spec(shape, names, mesh, rules)
+        if spec is None:
+            logical = jax.sharding.PartitionSpec(
+                *(names if names is not None else ()))
+            return nn.logical_to_mesh_sharding(logical, mesh, list(rules))
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(
+        leaf, abstract_boxed,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned),
+    )
+
+
+def zero3_replicated_waste(
+    shapes_and_names, mesh: Mesh, rules=DEFAULT_LOGICAL_RULES
+) -> float:
+    """Fraction of master elements zero3 cannot shard (no free dim
+    divides the shard count) — the layout's per-device overhead over a
+    perfect 1/dp split, the analogue of the flat engine's zero-padding
+    waste. ``shapes_and_names``: iterable of (shape, names) pairs from
+    the boxed abstract tree. Returns 0.0 for an empty tree."""
+    total = stuck = 0
+    for shape, names in shapes_and_names:
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n
+        if zero3_leaf_spec(shape, names, mesh, rules) is None:
+            stuck += n
+    return stuck / total if total else 0.0
+
+
+def constrain_replicated(x: jax.Array, mesh: Mesh | None = None) -> jax.Array:
+    """Pin one in-graph array to the fully replicated layout — the
+    zero3 engine's *materialization* point: applied to a sharded master
+    (or a bf16 cast of one) it makes GSPMD insert the all-gather exactly
+    here, which the named scopes at the call sites
+    (``zero3_gather``/``zero3_stream``/``zero3_prefetch``) then pin for
+    the collective-census attribution. Only safe where the leaf carries
+    no model-parallel dims (the zero3 stream gates itself on a
+    model-parallel-free config). No-op without a mesh."""
+    if mesh is None:
+        from dinov3_tpu.parallel.context import get_current_mesh
+
+        mesh = get_current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, jax.sharding.PartitionSpec()))
+
+
+def zero3_materialize_tree(tree: Any, mesh: Mesh | None = None) -> Any:
+    """Replicate every leaf of a zero3-sharded master subtree for
+    compute (the ZeRO-3 "gather params for this pass" step), under the
+    ``zero3_gather`` named scope so the census attributes the
+    collectives. Used by the meta arch for the NON-streamed subtrees
+    (heads, patch embed, norms); the scanned block stack never goes
+    through this — its weights are gathered per block inside the scan
+    (ops/block.py zero3 stream). No-op without a mesh."""
+    if mesh is None:
+        from dinov3_tpu.parallel.context import get_current_mesh
+
+        mesh = get_current_mesh()
+    if mesh is None:
+        return tree
+    with jax.named_scope("zero3_gather"):
+        return jax.tree.map(lambda x: constrain_replicated(x, mesh), tree)
 
 
 def make_sharded_init(

@@ -12,9 +12,17 @@ Two instruments, same record shape:
 - ``per_device_state_bytes(tree)``: the sharding-aware footprint of one
   pytree (train state, ring, batch) — per-device bytes from each leaf's
   addressable shards. This is the SimpleFSDP-style deliverable the
-  ZeRO-3 work (ROADMAP item 1) diffs before/after sharding the
+  ZeRO-3 engine (parallel.zero3, PR 7) diffs before/after sharding the
   masters: it reads the layout the partitioner actually chose, not the
   logical shapes.
+- ``layout_split(tree, shardings)``: the same accounting from ASSIGNED
+  ``NamedSharding``s (works on abstract ``ShapeDtypeStruct`` trees —
+  the ``build_train_setup(init_state=False)`` compile-only dryrun path
+  MEM artifacts use), split into replicated vs sharded bytes. Its
+  ``replicated_fraction`` is the pin that keeps a zero3 MEM artifact
+  from silently reporting the replicated footprint: a sharded-masters
+  arm whose masters count as replicated is an accounting bug, and
+  scripts/cost_zero3.py + tests/test_zero3.py assert on it.
 
 Sampled at setup/compile boundaries and at every metrics flush
 (train/train.py via ``SpanTracer.emit_memory``), and summarized into
@@ -91,4 +99,43 @@ def per_device_state_bytes(tree) -> dict:
         "per_device": per_dev,
         "total": sum(per_dev.values()),
         "max_per_device": max(per_dev.values()) if per_dev else 0,
+    }
+
+
+def layout_split(tree, shardings) -> dict:
+    """Replicated-vs-sharded byte split of one pytree under assigned
+    ``NamedSharding``s.
+
+    Works on abstract trees (``ShapeDtypeStruct`` leaves — the
+    compile-only MEM dryrun) and concrete ones alike: per-device bytes
+    come from each leaf's ``shard_shape``, and a leaf counts as
+    replicated when its shard equals the full array on a multi-device
+    mesh. Returns ``{"full_bytes", "per_device_bytes",
+    "replicated_bytes", "replicated_fraction"}`` — ``replicated_bytes``
+    is the per-device share that does NOT shrink with the mesh, and
+    ``replicated_fraction`` its share of the full tree (0.0 when every
+    leaf shards; the zero3 MEM pin asserts it stays near 0 for the
+    masters)."""
+    import math
+
+    import jax
+
+    full_total = per_dev_total = rep_total = 0
+    for leaf, sh in zip(jax.tree.leaves(tree), jax.tree.leaves(shardings)):
+        shape = tuple(leaf.shape)
+        itemsize = leaf.dtype.itemsize
+        full = math.prod(shape) * itemsize if shape else itemsize
+        shard = (math.prod(sh.shard_shape(shape)) * itemsize
+                 if shape else itemsize)
+        full_total += full
+        per_dev_total += shard
+        multi = getattr(getattr(sh, "mesh", None), "size", 1) > 1
+        if multi and shard == full:
+            rep_total += full
+    return {
+        "full_bytes": full_total,
+        "per_device_bytes": per_dev_total,
+        "replicated_bytes": rep_total,
+        "replicated_fraction": (rep_total / full_total
+                                if full_total else 0.0),
     }

@@ -14,7 +14,14 @@ specs (dinov3_jax/train/train.py:319-604). Here:
 - under the cross-replica sharded update engine (optim.sharded_update,
   auto = on at data-parallel size > 1), the adam moments are born in the
   flat "update_shard" layout — each replica stores and updates 1/dp of
-  every master/moment/teacher leaf (train/fused_update.py).
+  every master/moment/teacher leaf (train/fused_update.py),
+- under the ZeRO-3 weight-streaming engine (parallel.zero3, auto = on
+  at fsdp > 1 — supersedes the flat engine), the fp32 masters, EMA
+  teacher AND adam moments are ALL born sharded over the data axes in
+  their model shapes (parallel/sharding.py zero3_*): compute weights
+  re-materialize at use (per block inside the block scan, ops/block.py),
+  the update runs shard-local, and the step's out_shardings keep the
+  masters sharded — no trailing all-gather.
 """
 
 from __future__ import annotations
@@ -86,6 +93,7 @@ class TrainSetup:
     batch_shardings: dict
     fused_update: Callable | None = None  # single-pass engine, None = optax chain
     sharded_update: bool = False  # cross-replica sharded form of the engine
+    zero3: bool = False  # ZeRO-3 weight-streaming layout (masters sharded)
     # lazy TelemetryPlan builder; None = telemetry.async_metrics=false
     # (the per-step-fetch oracle path is then the only metrics path)
     telemetry_builder: Callable | None = None
@@ -157,13 +165,33 @@ def build_train_setup(
     from dinov3_tpu.parallel.sharding import update_shard_size
 
     dp = update_shard_size(mesh)
+    # ZeRO-3 weight streaming (parallel.zero3, default on at fsdp > 1):
+    # masters/teacher/moments born sharded over the data axes in their
+    # MODEL shapes (parallel/sharding.py zero3_*), compute weights
+    # re-materialized at use (per block inside the scan). It SUPERSEDES
+    # the flat sharded-update engine: the moments are already 1/dp here
+    # and the update runs shard-local through the plain fused engine —
+    # a flat repack would just add an all-to-all per step.
+    from dinov3_tpu.configs.config import zero3_wished
+
+    use_zero3 = zero3_wished(cfg) and dp > 1
     sharded_wished = cfg.optim.get("sharded_update", "auto")
+    sharded_explicit = (not isinstance(sharded_wished, str)
+                        or sharded_wished.lower() != "auto")
     if isinstance(sharded_wished, str):
         sharded_wished = sharded_wished.lower() in ("auto", "true", "on")
-    use_sharded = bool(sharded_wished) and fused_wished and dp > 1
-    if (bool(sharded_wished) and not fused_wished
-            and str(cfg.optim.get("sharded_update", "auto")).lower()
-            not in ("auto",)):
+    if use_zero3 and sharded_explicit and bool(sharded_wished):
+        raise ValueError(
+            "optim.sharded_update=true conflicts with parallel.zero3: "
+            "under zero3 the masters AND moments are already sharded "
+            "and the update is shard-local — the flat update_shard "
+            "repack would reshard them every step. Set "
+            "optim.sharded_update=auto (it yields to zero3) or "
+            "parallel.zero3=false."
+        )
+    use_sharded = (bool(sharded_wished) and fused_wished and dp > 1
+                   and not use_zero3)
+    if (bool(sharded_wished) and not fused_wished and sharded_explicit):
         raise ValueError(
             "optim.sharded_update=true requires optim.fused_update=true "
             "(the sharded engine is the fused single-pass math over "
@@ -231,6 +259,42 @@ def build_train_setup(
     state_shardings = state_shardings_from_abstract(
         abstract, mesh, DEFAULT_LOGICAL_RULES
     )
+    if use_zero3:
+        # masters, EMA teacher AND adam moments born zero3-sharded: the
+        # logical-rules shardings of the params/mu/nu subtrees are
+        # overridden with the zero3 placement (one dividing dim per
+        # leaf over the data axes, model shapes kept); everything else
+        # (centers, counters, step) stays as derived
+        from dinov3_tpu.parallel.sharding import (
+            zero3_replicated_waste,
+            zero3_shardings_from_abstract,
+        )
+
+        state_shardings = state_shardings._replace(
+            params=zero3_shardings_from_abstract(abstract.params, mesh),
+            opt_state=state_shardings.opt_state._replace(
+                adam=state_shardings.opt_state.adam._replace(
+                    mu=zero3_shardings_from_abstract(
+                        abstract.opt_state.adam.mu, mesh),
+                    nu=zero3_shardings_from_abstract(
+                        abstract.opt_state.adam.nu, mesh),
+                )
+            ),
+        )
+        # layout guardrail: warn when > 1% of the master elements have
+        # no dividing dim and stay replicated on every device
+        import flax.linen as nn_meta
+
+        from dinov3_tpu.configs.config import warn_zero3_padding
+
+        pairs = [
+            (l.value.shape, l.names) if isinstance(l, nn_meta.Partitioned)
+            else (l.shape, (None,) * len(l.shape))
+            for l in jax.tree.leaves(
+                abstract.params,
+                is_leaf=lambda x: isinstance(x, nn_meta.Partitioned))
+        ]
+        warn_zero3_padding(zero3_replicated_waste(pairs, mesh), dp)
 
     import flax.linen as nn
 
@@ -312,7 +376,8 @@ def build_train_setup(
         cfg=cfg, meta=meta, mesh=mesh, schedules=schedules,
         optimizer=optimizer, state=state, state_shardings=state_shardings,
         step_fn=step_fn, batch_shardings=b_shardings, fused_update=fused,
-        sharded_update=use_sharded, telemetry_builder=telemetry_builder,
+        sharded_update=use_sharded, zero3=use_zero3,
+        telemetry_builder=telemetry_builder,
     )
 
 

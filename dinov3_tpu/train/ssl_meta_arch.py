@@ -205,6 +205,16 @@ class SSLMetaArch:
             self.crop_packing = bool(cp)
         if self.crop_packing:
             self.crop_packing = self._resolve_crop_packing(cfg, pipe)
+        # ZeRO-3 weight streaming (parallel.zero3, train/setup.py): the
+        # forward materializes the NON-block master subtrees (heads,
+        # patch embed, final norms) once per step under the
+        # ``zero3_gather`` scope; the block stacks are excluded — their
+        # weights gather per block inside the stack (the
+        # ``zero3_stream`` wrapper the backbones carry). Same
+        # model-parallel-free gate as the stream; inert without a mesh.
+        from dinov3_tpu.configs.config import zero3_stream_wished
+
+        self.zero3_gather = zero3_stream_wished(cfg)
         self.gram_enabled = bool(cfg.gram.use_loss)
         self.gram_uses_ema_teacher = bool(cfg.gram.ema_teacher)
         # per-iteration loss-weight ramps (host numpy; moved in-graph by the
@@ -805,6 +815,13 @@ class SSLMetaArch:
         ``build_rng_plan``); the teacher/gram passes are deterministic
         and consume neither."""
         frozen = jax.lax.stop_gradient(frozen_params)
+        # ZeRO-3: replicate the non-streamed master subtrees for this
+        # step's compute (heads/patch-embed/norms; the block stacks stay
+        # sharded and gather per block inside the scan). Differentiated
+        # for the student — the constraint's transpose is the grad
+        # reduce-scatter back to the sharded master layout.
+        student_params = self._zero3_gather_params(student_params)
+        frozen = self._zero3_gather_params(frozen)
         teacher_global, new_state = self.get_teacher_output(
             frozen["teacher"], batch, teacher_temp, state, update_centers,
         )
@@ -821,6 +838,35 @@ class SSLMetaArch:
             batch, iteration,
         )
         return total, (loss_dict, new_state)
+
+    def _zero3_gather_params(self, tree):
+        """Materialize (replicate) every master leaf of a zero3-sharded
+        param tree for compute, EXCEPT the block-stack subtrees
+        (``blocks`` / ``blocks_i`` / ``pipeline``) — those stream per
+        block inside the stack. No-op when zero3 gathering is off or no
+        mesh is active, and shape-preserving always (zero3 never changes
+        leaf shapes), so both engine arms share this code path
+        structurally."""
+        if not self.zero3_gather:
+            return tree
+        from dinov3_tpu.parallel.context import get_current_mesh
+        from dinov3_tpu.parallel.sharding import constrain_replicated
+
+        mesh = get_current_mesh()
+        if mesh is None:
+            return tree
+
+        def walk(sub):
+            if not isinstance(sub, dict):
+                return constrain_replicated(sub, mesh)
+            return {
+                k: (v if k == "blocks" or k.startswith("blocks_")
+                    or k == "pipeline" else walk(v))
+                for k, v in sub.items()
+            }
+
+        with jax.named_scope("zero3_gather"):
+            return walk(tree)
 
     def update_ema(self, teacher_params, student_params, momentum):
         """teacher <- m * teacher + (1 - m) * student.

@@ -293,6 +293,12 @@ def classify_copy(line: str) -> str:
       dynamic-update-slice per step), attributed so the telemetry
       step's census ceiling names its own cost instead of absorbing it
       into "small" (tests/test_telemetry.py pins the ceiling).
+    - "zero3": copies inside the ZeRO-3 engine's materialization sites
+      (the ``zero3_gather``/``zero3_stream``/``zero3_prefetch`` named
+      scopes — ssl_meta_arch._zero3_gather_params, ops/block.py
+      _zero3_stream_trans_in, models/streaming.py) — the layout traffic
+      weight streaming introduces, named so the census ceiling
+      attributes it instead of absorbing it into "small"/"large".
     - "rng": u32 results of <= 8 elements — threefry key/counter
       plumbing (keys are u32[2]/u32[4]; fold_in intermediates scalar).
     - "small": any other result of <= 1024 elements (scalar metrics,
@@ -308,6 +314,9 @@ def classify_copy(line: str) -> str:
         return "update_shard"
     if "telemetry_ring" in line:
         return "telemetry"
+    if ("zero3_gather" in line or "zero3_stream" in line
+            or "zero3_prefetch" in line):
+        return "zero3"
     shp = _hlo_result_shape(line)
     if shp is None:
         return "small"
@@ -402,6 +411,50 @@ def classify_collective(line: str) -> str | None:
     return None
 
 
+# named-scope markers -> attribution category for collectives: the
+# engine scopes (zero3 weight streaming, the sharded update's flat
+# pack, crop packing) wrap their materialization/collective sites, and
+# the GSPMD-inserted collectives inherit the scope in their op_name
+# metadata — so the census can say WHICH engine asked for each
+# collective, not just its opcode class. Order matters: first match
+# wins (prefetch before stream — the prefetch scope nests inside the
+# stream program).
+HLO_COLLECTIVE_SCOPES = (
+    ("zero3_prefetch", "zero3_prefetch"),
+    ("zero3_stream", "zero3_stream"),
+    ("zero3_gather", "zero3_gather"),
+    ("update_shard", "update_shard"),
+    ("crop_pack", "gather_pack"),
+    ("crop_unpack", "gather_pack"),
+    ("telemetry_ring", "telemetry"),
+)
+
+
+def classify_collective_scope(line: str) -> str:
+    """Named-scope attribution category for one collective HLO line
+    (``HLO_COLLECTIVE_SCOPES``), or "other" when no engine scope claims
+    it (model-structure collectives: grad all-reduces, loss psums,
+    ring ppermutes)."""
+    for marker, cat in HLO_COLLECTIVE_SCOPES:
+        if marker in line:
+            return cat
+    return "other"
+
+
+def hlo_collective_in_loop(line: str) -> bool:
+    """Whether a collective instruction executes inside a compiled loop
+    body (the block scan / K-tile scan): jax stamps loop-body ops with a
+    ``while`` component in their op_name metadata (``.../while/body/...``,
+    ``jvp(while)``, ``transpose(jvp(while))``), which survives into the
+    partitioned HLO — the placement signal behind the weight-stream and
+    prefetch-overlap columns (an all-gather inside the block loop is a
+    per-block stream gather; outside, a whole-tree materialization)."""
+    import re
+
+    m = re.search(r'op_name="([^"]*)"', line)
+    return bool(m and "while" in m.group(1))
+
+
 def hlo_collective_census(hlo_text: str) -> dict:
     """Collective op counts + result bytes per class for one compiled
     HLO module (non-fusion lines; ``-start``/plain forms counted once,
@@ -412,8 +465,21 @@ def hlo_collective_census(hlo_text: str) -> dict:
     shard, for an all-gather the re-assembled full buffer — so the
     by-class byte totals read directly as the per-device collective
     traffic story of the module. Classes: see ``classify_collective``.
+
+    Beyond ``by_class``, the census attributes every collective to the
+    engine named scope that asked for it (``by_scope``,
+    ``classify_collective_scope``) and records the weight-stream /
+    prefetch-overlap story of the all-gathers (``prefetch_overlap``):
+    how many gathers run inside loop bodies (the per-block stream),
+    how many of those were issued AHEAD of their consuming block (the
+    ``zero3_prefetch`` scope — the double-buffered schedule), and how
+    many are issued at use (``zero3_stream``; overlap is then the async
+    scheduler's job). The zero3 acceptance pins read these columns.
     """
     by_class: dict = {}
+    by_scope: dict = {}
+    ag_in_loop_ops = ag_in_loop_bytes = 0
+    ag_prefetch = ag_at_use = 0
     total_ops = 0
     total_bytes = 0
     for line in hlo_non_fusion_lines(hlo_text):
@@ -425,11 +491,30 @@ def hlo_collective_census(hlo_text: str) -> dict:
         ent = by_class.setdefault(cat, {"ops": 0, "bytes": 0})
         ent["ops"] += 1
         ent["bytes"] += nbytes
+        scope = classify_collective_scope(line)
+        s_ent = by_scope.setdefault(scope, {"ops": 0, "bytes": 0})
+        s_ent["ops"] += 1
+        s_ent["bytes"] += nbytes
+        if cat == "all_gather":
+            if hlo_collective_in_loop(line):
+                ag_in_loop_ops += 1
+                ag_in_loop_bytes += nbytes
+            if scope == "zero3_prefetch":
+                ag_prefetch += 1
+            elif scope == "zero3_stream":
+                ag_at_use += 1
         total_ops += 1
         total_bytes += nbytes
     return {
         "hlo_collective_total": total_ops,
         "hlo_collective_bytes": total_bytes,
         "by_class": by_class,
+        "by_scope": by_scope,
+        "prefetch_overlap": {
+            "all_gather_in_loop_ops": ag_in_loop_ops,
+            "all_gather_in_loop_bytes": ag_in_loop_bytes,
+            "prefetch_scoped_ops": ag_prefetch,
+            "at_use_scoped_ops": ag_at_use,
+        },
         "unattributed": by_class.get("unattributed", {"ops": 0})["ops"],
     }

@@ -229,6 +229,21 @@ run_bench phO_telemetry_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1
 run_bench phO_telemetry_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=telemetry.async_metrics=false
 
+# phW: ZeRO-3 weight-streaming engine A/B. Treatment = the streamed
+# program (parallel.zero3=true + scan_layers: masters/teacher/moments
+# born sharded over the data axes, block weights gathered per block
+# inside the scan); control strips ONLY the engine
+# (parallel.zero3=false — replicated masters, same scanned stack).
+# Both arms carry the censuses so the record pairs the throughput
+# delta with the per-device state bytes (the "zero3" summary block),
+# the scoped gather counts, and the REAL gather dtype — the CPU census
+# float-normalizes bf16 collectives to f32, so the bf16-stream bytes
+# claim is settled here, on chip.
+run_bench phW_zero3_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=parallel.zero3=true,train.scan_layers=true
+run_bench phW_zero3_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=parallel.zero3=false,train.scan_layers=true
+
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including
 # the unmeasured 2048-2309 band and the flash side at N>=2309).

@@ -266,9 +266,11 @@ def test_build_train_setup_defaults_to_fused(eight_devices):
     from dinov3_tpu.train import build_train_setup, put_batch
 
     for axes, extra in (
-        (["parallel.data=-1", "parallel.fsdp=2", "parallel.seq=2"],
+        (["parallel.data=-1", "parallel.fsdp=2", "parallel.seq=2",
+          "parallel.zero3=false"],
          ["student.drop_path_rate=0.5", "student.drop_path_mode=subset"]),
-        (["parallel.data=-1", "parallel.fsdp=2", "parallel.tensor=2"],
+        (["parallel.data=-1", "parallel.fsdp=2", "parallel.tensor=2",
+          "parallel.zero3=false"],
          ["optim.fused_update=false"]),
     ):
         cfg = smol_cfg(axes + extra)
@@ -294,6 +296,7 @@ def test_sharded_fused_matches_oracle(eight_devices):
     results = {}
     for flag in ("true", "false"):
         cfg = smol_cfg(["parallel.data=-1", "parallel.fsdp=2",
+                        "parallel.zero3=false",
                         f"optim.fused_update={flag}"])
         batch = {k: jnp.asarray(v) for k, v in
                  make_synthetic_batch(cfg, 8, seed=0).items()}

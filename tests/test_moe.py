@@ -95,6 +95,7 @@ def test_moe_train_step_expert_parallel(eight_devices):
     cfg = get_default_config()
     apply_dot_overrides(cfg, SMOL_MOE + [
         "parallel.data=2", "parallel.fsdp=2", "parallel.expert=2",
+        "parallel.zero3=false",
     ])
     B = 8
     batch = {k: jnp.asarray(v) for k, v in

@@ -90,7 +90,8 @@ def test_sharded_train_step(eight_devices, axes):
 def test_sharded_matches_single_device(eight_devices):
     """DPx(FSDP) global math == single-device math on the same batch."""
     B = 8
-    cfg = smol_cfg(["parallel.data=-1", "parallel.fsdp=2"])
+    cfg = smol_cfg(["parallel.data=-1", "parallel.fsdp=2",
+                    "parallel.zero3=false"])
     batch = {k: jnp.asarray(v) for k, v in
              make_synthetic_batch(cfg, B, seed=0).items()}
 
@@ -151,6 +152,7 @@ def test_vocab_sharded_sinkhorn_7b_shapes(eight_devices):
     cfg8 = get_default_config()
     apply_dot_overrides(cfg8, proto + [
         "parallel.data=-1", "parallel.fsdp=2", "parallel.tensor=2",
+        "parallel.zero3=false",
     ])
     B = 4
     batch = {k: jnp.asarray(v) for k, v in
@@ -198,7 +200,7 @@ def test_sharded_train_step_subset_drop_path(eight_devices):
     with traced indices partitions (or falls back to a collective), and
     the step still runs and learns finitely."""
     cfg = smol_cfg([
-        "parallel.data=-1", "parallel.fsdp=2",
+        "parallel.data=-1", "parallel.fsdp=2", "parallel.zero3=false",
         "student.drop_path_rate=0.5", "student.drop_path_mode=subset",
     ])
     # data_parallel_size = data(4) x fsdp(2) = 8 -> groups=8; B=16 gives
@@ -232,7 +234,7 @@ def test_subset_drop_path_collective_budget(eight_devices):
 
     def counts(mode):
         cfg = smol_cfg([
-            "parallel.data=-1", "parallel.fsdp=2",
+            "parallel.data=-1", "parallel.fsdp=2", "parallel.zero3=false",
             "student.drop_path_rate=0.5",
             f"student.drop_path_mode={mode}",
         ])

@@ -89,6 +89,7 @@ def test_seq_parallel_train_step(eight_devices):
         "train.OFFICIAL_EPOCH_LENGTH=4", "optim.epochs=4",
         "optim.scaling_rule=none",
         "parallel.data=2", "parallel.fsdp=2", "parallel.seq=2",
+        "parallel.zero3=false",
     ])
     batch = {k: jnp.asarray(v) for k, v in
              make_synthetic_batch(cfg, 4, seed=0).items()}

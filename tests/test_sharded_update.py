@@ -210,7 +210,9 @@ def _setup(extra, batch_size, eight_devices):
     from dinov3_tpu.data import make_synthetic_batch
     from dinov3_tpu.train import build_train_setup
 
-    cfg = smol_cfg(extra)
+    # pin the PR-5 flat engine arms: zero3 (PR 7) otherwise auto-takes
+    # the fsdp>1 meshes and swaps the moment layout this file pins
+    cfg = smol_cfg(["parallel.zero3=false"] + list(extra))
     batch = {k: jnp.asarray(v) for k, v in
              make_synthetic_batch(cfg, batch_size, seed=0).items()}
     return build_train_setup(cfg, batch, devices=eight_devices), batch
