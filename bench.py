@@ -371,6 +371,36 @@ def _zero3_summary(setup, coll_census) -> dict:
     return out
 
 
+def _bucket_summary(setup, coll_census) -> dict:
+    """The record's "buckets" block: arm, plan shape (bucket count /
+    payload / zero-pad fraction from BucketPlan.padding_stats) and
+    (census runs only) the bucket-scoped collective counts plus the
+    program-wide message-size histogram and issue-site placement of the
+    benched program — the phB A/B reads the coalescing story straight
+    from here."""
+    plan = getattr(setup, "bucket_plan", None)
+    out = {"arm": bool(getattr(setup, "bucketed", False))}
+    if plan is not None:
+        rows = plan.padding_stats()
+        payload = sum(r["bytes"] for r in rows)
+        pad = sum(r["pad_elems"] * (r["bytes"] // max(r["elems"], 1))
+                  for r in rows)
+        out.update({
+            "n_buckets": len(rows),
+            "n_leaves": sum(r["n_leaves"] for r in rows),
+            "payload_bytes": int(payload),
+            "pad_fraction": round(pad / max(payload, 1), 4),
+            "target_bytes": int(plan.target_bytes),
+        })
+    if coll_census and "by_scope" in coll_census:
+        out["collectives_by_scope"] = {
+            k: v for k, v in coll_census["by_scope"].items()
+            if k.startswith("bucket")}
+        out["size_histogram"] = coll_census.get("size_histogram")
+        out["by_placement"] = coll_census.get("by_placement")
+    return out
+
+
 _CURRENT_CHILD = {"proc": None}
 
 
@@ -657,6 +687,11 @@ def main():
     # warn_zero3_padding), same capture pattern
     zero3_warnings = [str(w.message) for w in _bcaught
                       if "zero3 master layout" in str(w.message)]
+    # ... and the bucket-plan guardrail (configs/config.py
+    # warn_bucket_padding: zero-pad fraction + straggler buckets)
+    bucket_warnings = [str(w.message) for w in _bcaught
+                       if "bucket flat axis" in str(w.message)
+                       or "bucket size axis" in str(w.message)]
     dbatch = put_batch(batch, setup.batch_shardings)
     rng = jax.random.key(0)
     state = setup.state
@@ -784,6 +819,11 @@ def main():
         # masters story straight from here), and — when the census ran —
         # the engine-scoped gather counts of the exact benched program
         "zero3": _zero3_summary(setup, coll_census),
+        # bucketed-collectives summary: which grad-sync arm was benched,
+        # the plan's bucket count / payload / pad fraction, and — when
+        # the census ran — the bucket-scoped collective counts plus the
+        # message-size histogram and issue-site placement
+        "buckets": _bucket_summary(setup, coll_census),
     }
     if census is not None:
         rec["copy_census"] = census
@@ -795,6 +835,8 @@ def main():
         rec["update_shard_padding_warning"] = "; ".join(pad_warnings)
     if zero3_warnings:
         rec["zero3_padding_warning"] = "; ".join(zero3_warnings)
+    if bucket_warnings:
+        rec["bucket_padding_warning"] = "; ".join(bucket_warnings)
     if degraded:
         # distinct reasons can fire for the global- and local-crop
         # batches of the same program — keep them all

@@ -52,6 +52,52 @@ def _adapt_opt_leaf(stored, like):
     )
 
 
+def _bucketed_moments(state, plan) -> bool:
+    """True when ``state``'s adam moments are in ``plan``'s bucket layout
+    (the ``optim.bucketed_collectives`` engine,
+    train/fused_update.py make_bucketed_update): a dict keyed by bucket
+    name instead of the per-leaf / param-shaped trees every other arm
+    carries. The on-disk format is ALWAYS per-leaf, so the bucketed arm
+    converts at this boundary in both directions."""
+    if plan is None:
+        return False
+    adam = getattr(getattr(state, "opt_state", None), "adam", None)
+    mu = getattr(adam, "mu", None)
+    try:
+        return sorted(dict(mu).keys()) == sorted(plan.names)
+    except (TypeError, ValueError):
+        return False
+
+
+def _flat_moment_abstract(plan):
+    """Per-leaf flat padded abstract moments (``sharded_adam_zeros``
+    shapes) for ``plan``'s student tree — the layout bucketed moments
+    persist as. Plain ShapeDtypeStructs, no sharding: the restore path
+    stages them addressably and re-places them bucket-by-bucket."""
+    import numpy as np
+
+    leaves = [None] * plan.n_leaves
+    for b in plan.buckets:
+        for m in b.members:
+            leaves[m.index] = jax.ShapeDtypeStruct(
+                (m.padded,), np.dtype(b.dtype)
+            )
+    return jax.tree.unflatten(plan.treedef, leaves)
+
+
+def _moments_to_flat(state, plan):
+    """Bucket-layout state -> same state with per-leaf flat moments (the
+    on-disk layout). Pure index permutation (BucketPlan layout comment),
+    bitwise lossless."""
+    adam = state.opt_state.adam._replace(
+        mu=plan.buckets_to_flat_tree(dict(state.opt_state.adam.mu)),
+        nu=plan.buckets_to_flat_tree(dict(state.opt_state.adam.nu)),
+    )
+    return state._replace(
+        opt_state=state.opt_state._replace(adam=adam)
+    )
+
+
 def _opt_moment_shapes(state_like):
     """The mu leaf-shape list of ``state_like``'s opt state, or None when
     the state does not carry the scheduled-adamw ``adam.mu`` subtree."""
@@ -136,12 +182,23 @@ class Checkpointer:
         async_save: bool = True,
         process_group: tuple[int, ...] | None = None,
         sync_prefix: str | None = None,
+        bucket_plan: Any = None,
     ):
         """``process_group``: restrict orbax's cross-host barriers to these
         process indices (multidistillation subgroups checkpoint disjoint
         students concurrently; a global barrier would interleave/deadlock
-        across groups). ``sync_prefix`` keys the group's barriers apart."""
+        across groups). ``sync_prefix`` keys the group's barriers apart.
+
+        ``bucket_plan``: the run's ``BucketPlan`` when the bucketed
+        collective engine is on (``TrainSetup.bucket_plan``); the train
+        loop assigns it after setup (the plan needs the traced abstract
+        params, the checkpointer must exist before them to announce the
+        resume step). With a plan set, bucket-layout adam moments are
+        converted to the per-leaf flat layout on save and back on
+        restore, so on-disk checkpoints stay arm-independent."""
         import os
+
+        self.bucket_plan = bucket_plan
 
         directory = os.path.abspath(directory)
         extra = {}
@@ -259,6 +316,10 @@ class Checkpointer:
 
     def save(self, step: int, state: TrainState) -> bool:
         """Async save; returns True if a save was started."""
+        if _bucketed_moments(state, self.bucket_plan):
+            # persist the per-leaf layout so any arm restores this
+            # checkpoint (pure permutation, bitwise)
+            state = _moments_to_flat(state, self.bucket_plan)
         if self._local:
             saved = self._local_save(step, state)
         else:
@@ -304,17 +365,50 @@ class Checkpointer:
         the same ``_adapt_opt_leaf`` flat/full path as flat <->
         replicated. Round-trips and resume determinism across all three
         arms are pinned in tests/test_zero3.py.
+
+        The bucketed arm (``optim.bucketed_collectives``) carries its
+        moments as {bucket_name: flat} dicts — a different TREE, not
+        just different shapes — but persists them per-leaf (``save``
+        above), so its checkpoints are indistinguishable on disk from
+        the flat-sharded arm's. Restoring INTO a bucketed run restores
+        against the per-leaf on-disk layout first (riding the same
+        ``_adapt_opt_leaf`` machinery when the checkpoint came from a
+        replicated/zero3 arm) and re-buckets at the end
+        (``_rebucket_moments`` — pure permutation + per-bucket
+        device_put). Pinned in tests/test_buckets.py.
         """
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found")
+        bucketed = _bucketed_moments(state_like, self.bucket_plan)
+        if bucketed:
+            # the like-state in the per-leaf ON-DISK layout; re-bucketed
+            # after the restore below
+            state_like_disk = state_like._replace(
+                opt_state=state_like.opt_state._replace(
+                    adam=state_like.opt_state.adam._replace(
+                        mu=_flat_moment_abstract(self.bucket_plan),
+                        nu=_flat_moment_abstract(self.bucket_plan),
+                    )
+                )
+            )
+        else:
+            state_like_disk = state_like
         if self._local:
-            restored = self._local_restore(state_like, step)
+            restored = self._local_restore(state_like_disk, step)
+            if bucketed:
+                restored = self._rebucket_moments(restored, state_like)
             logger.info("restored checkpoint at step %d (local npz)", step)
             return restored
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
+        abstract = jax.tree.map(
+            # the flat moment stand-ins are already abstract (and have
+            # no sharding for orbax to convert)
+            lambda x: (x if isinstance(x, jax.ShapeDtypeStruct)
+                       else ocp.utils.to_shape_dtype_struct(x)),
+            state_like_disk,
+        )
         adapt = False
-        like_shapes = _opt_moment_shapes(state_like)
+        like_shapes = _opt_moment_shapes(state_like_disk)
         if like_shapes is not None:
             try:
                 meta = item_metadata_tree(self.manager, step)
@@ -335,7 +429,7 @@ class Checkpointer:
             args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)),
         )["state"]
         if adapt:
-            adam_like = state_like.opt_state.adam
+            adam_like = state_like_disk.opt_state.adam
 
             def put(stored, like):
                 v = _adapt_opt_leaf(stored, like)
@@ -352,12 +446,53 @@ class Checkpointer:
             restored = restored._replace(
                 opt_state=restored.opt_state._replace(adam=adam)
             )
+        if bucketed:
+            restored = self._rebucket_moments(restored, state_like)
+            logger.info(
+                "restored checkpoint at step %d (opt moments re-bucketed "
+                "from the per-leaf on-disk layout%s)", step,
+                ", cross-arm adapted" if adapt else "")
+            return restored
+        if adapt:
             logger.info(
                 "restored checkpoint at step %d (opt-state layout adapted "
                 "across update-engine arms)", step)
             return restored
         logger.info("restored checkpoint at step %d", step)
         return restored
+
+    def _rebucket_moments(self, restored, state_like):
+        """Per-leaf flat moments (the on-disk layout, possibly just
+        cross-arm adapted above) -> ``state_like``'s bucket layout and
+        placement. Host-side concat + per-bucket device_put — the same
+        single-host staging convenience as the cross-arm adapt path."""
+        import numpy as np
+
+        plan = self.bucket_plan
+        adam_like = state_like.opt_state.adam
+
+        def put_buckets(flat_tree, like_m):
+            like_m = dict(like_m)
+            buckets = plan.flat_tree_to_buckets(
+                jax.tree.map(np.asarray, flat_tree)
+            )
+            out = {}
+            for name in plan.names:
+                sharding = getattr(like_m[name], "sharding", None)
+                out[name] = (
+                    jax.device_put(buckets[name], sharding)
+                    if sharding is not None
+                    else jax.numpy.asarray(buckets[name])
+                )
+            return out
+
+        adam = restored.opt_state.adam._replace(
+            mu=put_buckets(restored.opt_state.adam.mu, adam_like.mu),
+            nu=put_buckets(restored.opt_state.adam.nu, adam_like.nu),
+        )
+        return restored._replace(
+            opt_state=restored.opt_state._replace(adam=adam)
+        )
 
     def wait_until_finished(self) -> None:
         if self._local:

@@ -451,6 +451,85 @@ def warn_update_shard_padding(
     return msg
 
 
+def bucketed_collectives_wished(cfg: ConfigNode) -> bool:
+    """Whether the config ASKS for the bucketed collective engine
+    (before the setup-time data-axis-size > 1 / fused / zero3 checks).
+
+    ``optim.bucketed_collectives``: auto (default) = on — the coalesced
+    schedule (one reduce-scatter per bucket, one all-gather per bucket,
+    train/fused_update.py make_bucketed_update) is the default whenever
+    the setup-time conditions hold (data-axis product > 1, fused update
+    on, zero3 off — zero3 shards the masters along model dims and
+    supersedes the flat-bucket layout); true = insist (setup raises if
+    the conditions cannot hold); false = the per-leaf schedule, the
+    bitwise test oracle."""
+    b = (cfg.get("optim") or {}).get("bucketed_collectives", "auto")
+    if isinstance(b, str):
+        bl = b.lower()
+        if bl == "auto":
+            return True
+        return bl in ("true", "on", "1")
+    return bool(b)
+
+
+def warn_bucket_padding(
+    stats, target_bytes: int, threshold: float = 0.05, stacklevel: int = 2,
+) -> list[str]:
+    """Guardrails on a built bucket plan — the axis-labelled style of
+    ``warn_update_shard_padding``, fired at training-setup build
+    (train/setup.py, where the plan is first assembled) and recorded by
+    ``bench.py``.
+
+    ``stats`` is ``BucketPlan.padding_stats()`` (one row per bucket with
+    ``elems``/``pad_elems``/``bytes``/``group``). Two failure modes:
+
+    * a bucket whose zero-pad fraction exceeds ``threshold`` (5%) — the
+      dp-alignment padding of its member leaves is no longer negligible
+      against the bucket payload, so the coalesced reduce-scatter and
+      all-gather move mostly zeros;
+    * a straggler bucket smaller than 1/8 of the MEDIAN bucket size —
+      the greedy leaf→bucket assignment stranded a small bucket whose
+      collective is back in the latency-bound regime the engine exists
+      to avoid (only meaningful when there are >= 2 buckets to compare).
+
+    Returns the list of messages ([] when the plan is clean)."""
+    import warnings
+
+    msgs = []
+    for row in stats:
+        total = int(row["elems"])
+        pad = int(row["pad_elems"])
+        frac = pad / total if total else 0.0
+        if frac > threshold:
+            msgs.append(
+                f"bucket flat axis [{row['name']}]: zero-padding the "
+                f"member leaves to the data-axis size wastes {frac:.1%} "
+                f"of the bucket (> {threshold:.0%}) — the coalesced "
+                f"collectives move that padding every step "
+                f"(train/fused_update.py make_bucket_plan). Use a "
+                f"data-parallel axis that divides the leaf sizes, or "
+                f"set optim.bucketed_collectives=false."
+            )
+    sizes = sorted(int(r["bytes"]) for r in stats)
+    if len(sizes) >= 2:
+        median = sizes[len(sizes) // 2]
+        for row in stats:
+            if int(row["bytes"]) * 8 < median:
+                msgs.append(
+                    f"bucket size axis [{row['name']}]: straggler "
+                    f"bucket of {int(row['bytes'])} bytes is smaller "
+                    f"than 1/8 of the median bucket ({median} bytes) — "
+                    f"its collective is back in the latency-bound "
+                    f"small-message regime the bucketed engine exists "
+                    f"to avoid. Retune optim.bucket_mb (target "
+                    f"{target_bytes} bytes), or set "
+                    f"optim.bucketed_collectives=false."
+                )
+    for m in msgs:
+        warnings.warn(m, stacklevel=stacklevel + 1)
+    return msgs
+
+
 def warn_telemetry_flush_period(
     cfg: ConfigNode, stacklevel: int = 2,
 ) -> str | None:

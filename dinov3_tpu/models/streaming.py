@@ -137,3 +137,123 @@ def make_block_apply(block_kwargs: dict, rope=None, seg=None) -> Callable:
             {"params": block_params}, x, rope, True, None, seg)
 
     return apply
+
+
+def pack_stream_buckets(stack_params: Any, n_buckets: int, dp: int):
+    """Coalesce the streamable block weights into ``n_buckets``
+    equal-sized flat buckets aligned to the block-scan structure.
+
+    ``stack_params``: pytree of stacked ``[n_blocks, ...]`` leaves (pass
+    it through ``cast_stream_leaves`` first so the buckets carry the
+    bf16 stream form). Bucket ``b`` holds blocks ``[b*g, (b+1)*g)``
+    (``g = n_blocks / n_buckets``, which must divide): the streamable
+    leaves (``ops/block.py stream_bucket_leaves`` — the same selection
+    the per-block ZeRO-3 stream gathers) of those blocks, flattened and
+    concatenated in tree order, zero-padded to a multiple of ``dp``.
+    Every bucket is the same size (each leaf contributes ``g`` equal
+    block slices), so the bucket axis scans — the double-buffer
+    convention of ``streamed_block_scan`` lifts from per-block gathers
+    to per-bucket gathers unchanged. Returns ``[n_buckets, S_pb]``.
+    """
+    from dinov3_tpu.ops.block import stream_bucket_leaves
+
+    leaves = stream_bucket_leaves(stack_params)
+    if not leaves:
+        raise ValueError("stack has no streamable (attn/mlp) leaves")
+    n_blocks = leaves[0][1].shape[0]
+    if n_blocks % n_buckets:
+        raise ValueError(
+            f"n_buckets={n_buckets} must divide n_blocks={n_blocks} "
+            f"(equal buckets are what makes the bucket axis scannable)"
+        )
+    g = n_blocks // n_buckets
+    dtype = leaves[0][1].dtype
+    rows = []
+    for b in range(n_buckets):
+        flat = jnp.concatenate([
+            leaf[b * g:(b + 1) * g].reshape(-1).astype(dtype)
+            for _, leaf in leaves
+        ])
+        rows.append(jnp.pad(flat, (0, (-flat.size) % max(1, dp))))
+    return jnp.stack(rows)
+
+
+def bucketed_stream_scan(
+    bucket_shards: jnp.ndarray,
+    x: jnp.ndarray,
+    mesh=None,
+    prefetch: bool = True,
+    consume_fn: Callable | None = None,
+):
+    """The BUCKETED forward weight-gather schedule, written explicitly —
+    ``streamed_block_scan``'s double-buffer convention lifted from
+    per-block gathers to per-bucket gathers, as a shard_map island so
+    the compiled HLO contains the literal per-bucket ``all_gather``
+    (and, under ``jax.grad``, its transpose ``psum_scatter`` inside the
+    BACKWARD while loop — the overlap-placement evidence
+    ``utils.hlo_collective_placement`` classifies and
+    scripts/cost_buckets.py censuses: param gathers ride the forward
+    loop, the coalesced grad reduce-scatter of bucket *i* is issued as
+    backward leaves bucket *i*'s consume, under bucket *i-1*'s backward
+    compute).
+
+    ``bucket_shards``: ``[n_buckets, S_pb]`` from ``pack_stream_buckets``
+    (dim 1 sharded over the data axes by the in_spec). ``prefetch=True``
+    gathers bucket i+1 under bucket i's consume (scope
+    ``bucket_prefetch``, priming gather ``bucket_gather``);
+    ``prefetch=False`` gathers at use (scope ``bucket_stream``) — the
+    A/B control. ``consume_fn(w_full, x) -> x`` consumes one gathered
+    bucket; the default is a cheap reduction coupling every weight
+    element into ``x`` (pass-granularity convention of the cost
+    scripts — the census prices the collective schedule, not the block
+    math).
+    """
+    if mesh is None:
+        from dinov3_tpu.parallel.context import get_current_mesh
+
+        mesh = get_current_mesh()
+    from jax.sharding import PartitionSpec as P
+
+    from dinov3_tpu.parallel.context import shard_map_compat
+    from dinov3_tpu.parallel.sharding import UPDATE_SHARD_AXES
+
+    axes = tuple(a for a in UPDATE_SHARD_AXES if a in mesh.shape)
+    n_buckets = int(bucket_shards.shape[0])
+    if consume_fn is None:
+        def consume_fn(w, x):
+            return x + jnp.mean(w).astype(x.dtype) * x
+
+    def body(shards, x):
+        def gather(i, scope):
+            s = jax.lax.dynamic_index_in_dim(shards, i, 0, keepdims=False)
+            with jax.named_scope(scope):
+                return jax.lax.all_gather(s, axes, tiled=True)
+
+        if not prefetch:
+            def at_use(x, i):
+                return consume_fn(gather(i, "bucket_stream"), x), None
+
+            x, _ = jax.lax.scan(at_use, x, jnp.arange(n_buckets))
+            return x
+
+        # prime the buffer: bucket 0 gathered before the loop
+        w0 = gather(jnp.asarray(0), "bucket_gather")
+
+        def step(carry, i):
+            x, w = carry
+            # issue bucket i+1's gather BEFORE consuming bucket i — the
+            # streamed_block_scan double buffer, per bucket
+            w_next = gather(
+                jnp.minimum(i + 1, n_buckets - 1), "bucket_prefetch")
+            x = consume_fn(w, x)
+            return (x, w_next), None
+
+        (x, _), _ = jax.lax.scan(step, (x, w0), jnp.arange(n_buckets))
+        return x
+
+    return shard_map_compat(
+        body, mesh=mesh,
+        in_specs=(P(None, axes), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(bucket_shards, x)

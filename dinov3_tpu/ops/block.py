@@ -179,6 +179,23 @@ def stream_castable_path(path) -> bool:
     return bool({"attn", "mlp"} & keys) and "router" not in keys
 
 
+def stream_bucket_leaves(stack_params):
+    """The streamable leaves of a stacked [L, ...] block-param tree, as
+    ordered ``(path, leaf)`` pairs — the exact ``stream_castable_path``
+    set the ZeRO-3 bf16 stream gathers per block. The bucketed forward
+    gather twin (models/streaming.py ``pack_stream_buckets``) coalesces
+    this set into block-group buckets; keeping the selection rule here,
+    next to the in-model stream wrapper, guarantees the two programs
+    stream the same leaf set."""
+    import jax.tree_util as jtu
+
+    return [
+        (path, leaf)
+        for path, leaf in jtu.tree_flatten_with_path(stack_params)[0]
+        if hasattr(leaf, "dtype") and stream_castable_path(path)
+    ]
+
+
 def _zero3_stream_trans_in(stream_dtype, constrain: bool = True):
     """``nn.map_variables`` trans_in_fn for the ZeRO-3 weight stream.
 

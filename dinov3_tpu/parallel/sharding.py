@@ -62,12 +62,26 @@ DEFAULT_LOGICAL_RULES = (
     # update phase (Xu et al. 2020's automatic cross-replica sharding,
     # realized through GSPMD annotations instead of a manual pass).
     ("update_shard", ("dcn_data", "data", "fsdp")),
+    # bucketed collective engine (train/fused_update.py
+    # make_bucketed_update): the flat axis of every COALESCED update
+    # bucket — a few large concatenations of padded-flat leaves grouped
+    # by (submodel, dtype, param-group) — splits over the same axes as
+    # "update_shard", so the one-reduce-scatter-per-bucket grad sync and
+    # the one-all-gather-per-bucket param/teacher re-materialization
+    # ride the mesh axes the batch already rides. Same placement as
+    # "update_shard", separate NAME: the census and the sharding
+    # metadata can tell a per-leaf flat shard from a coalesced bucket.
+    ("bucket", ("dcn_data", "data", "fsdp")),
 )
 
 # the mesh axes the sharded update engine splits over — one tuple shared
 # by the logical rule above, the in-graph constraint below, and the
 # setup-time axis-size product, so the three can never disagree
 UPDATE_SHARD_AXES = ("dcn_data", "data", "fsdp")
+
+# the bucketed collective engine splits its flat buckets over the same
+# axes (one bucket shard per data replica, like one update shard)
+BUCKET_AXES = UPDATE_SHARD_AXES
 
 # the ZeRO-3 weight-streaming engine (parallel.zero3, train/setup.py)
 # shards the fp32 masters / EMA teacher / adam moments over the same
@@ -160,6 +174,30 @@ def constrain_update_shard(x: jax.Array,
         return x
     spec = [None] * x.ndim
     spec[0] = tuple(a for a in UPDATE_SHARD_AXES if a in mesh.shape)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def constrain_bucket(x: jax.Array, mesh: Mesh | None = None) -> jax.Array:
+    """Pin one flat update BUCKET (1-D concatenation of padded-flat
+    leaves, size divisible by ``update_shard_size``) onto the data axes
+    — the "bucket" logical rule. The bucketed collective engine
+    (train/fused_update.py make_bucketed_update) routes each coalesced
+    grad/master/moment/teacher bucket through this, so the grad sync
+    lowers as ONE reduce-scatter per bucket and the updated-param
+    re-materialization as ONE all-gather per bucket, instead of one
+    collective per leaf (``constrain_update_shard``). No-op without a
+    mesh (replicated test shapes)."""
+    if mesh is None:
+        from dinov3_tpu.parallel.context import get_current_mesh
+
+        mesh = get_current_mesh()
+    if mesh is None:
+        return x
+    dp = update_shard_size(mesh)
+    if dp <= 1 or x.shape[0] % dp != 0:
+        return x
+    spec = [None] * x.ndim
+    spec[0] = tuple(a for a in BUCKET_AXES if a in mesh.shape)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 

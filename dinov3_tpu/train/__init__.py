@@ -1,6 +1,12 @@
 from dinov3_tpu.train.fused_update import (
+    BucketPlan,
+    build_bucketed_update,
     build_fused_update,
     build_sharded_update,
+    bucketed_adam_zeros,
+    make_bucket_plan,
+    make_bucketed_update,
+    make_bucketed_update_schedule,
     make_fused_update,
     make_sharded_update,
     make_sharded_update_schedule,
@@ -26,6 +32,9 @@ __all__ = [
     "build_fused_update", "make_fused_update",
     "build_sharded_update", "make_sharded_update",
     "make_sharded_update_schedule",
+    "BucketPlan", "make_bucket_plan", "bucketed_adam_zeros",
+    "build_bucketed_update", "make_bucketed_update",
+    "make_bucketed_update_schedule",
     "build_optimizer", "clip_by_per_submodel_norm", "per_submodel_norms",
     "scheduled_adamw",
     "build_multiplier_trees", "Schedules", "build_schedules",

@@ -410,6 +410,28 @@ def make_sharded_update(
         bc2 = 1 - b2 ** count_inc
 
         g_flat = jax.tree.map(to_shard, grads)
+        p_flat = jax.tree.map(to_shard, params)
+        t_flat = (jax.tree.map(to_shard, teacher) if ema
+                  else jax.tree.map(lambda _: jnp.float32(0.0), g_flat))
+        lm_flat = jax.tree.map(mult_to_shard, lr_mult, params)
+        wm_flat = jax.tree.map(mult_to_shard, wd_mult, params)
+        # fusion cut: the flat working set is materialized here, so the
+        # elementwise update subgraph below compiles independently of
+        # how the flat leaves were produced — the bucketed engine
+        # (make_bucketed_update) shares this exact subgraph behind the
+        # same barrier. The REDUCTION path is bitwise identical between
+        # the two arms regardless (the shard-interleaved bucket layout
+        # makes the coalesced reduce-scatter compute segment-for-segment
+        # the per-leaf sums; tests/test_buckets.py pins moments + clip
+        # norms bitwise). The elementwise outputs are bitwise wherever
+        # the backend honors the barrier as a fusion boundary; XLA:CPU
+        # expands optimization-barrier away pre-fusion, so on the CPU
+        # test harness params/teacher may drift by ~1-2 ulp of FMA
+        # contraction context (pinned at the PR-5 tolerances).
+        (g_flat, p_flat, t_flat, lm_flat, wm_flat, mu_in, nu_in) = (
+            jax.lax.optimization_barrier(
+                (g_flat, p_flat, t_flat, lm_flat, wm_flat,
+                 opt_state.adam.mu, opt_state.adam.nu)))
         norms = {}
         if do_clip:
             # the identical per_submodel_norms graph as the oracle, now
@@ -427,12 +449,6 @@ def make_sharded_update(
         else:
             scale_tree = jax.tree.map(lambda _: _NO_CLIP, g_flat)
 
-        p_flat = jax.tree.map(to_shard, params)
-        t_flat = (jax.tree.map(to_shard, teacher) if ema
-                  else jax.tree.map(lambda _: 0.0, g_flat))
-        lm_flat = jax.tree.map(mult_to_shard, lr_mult, params)
-        wm_flat = jax.tree.map(mult_to_shard, wd_mult, params)
-
         def leaf(g, p, mu, nu, t, lm, wm, is_ll, scale):
             return update_leaf_math(
                 g, p, mu, nu, t, lm, wm, is_ll, scale,
@@ -441,7 +457,7 @@ def make_sharded_update(
 
         n_out = 4 if ema else 3
         fused = jax.tree.map(
-            leaf, g_flat, p_flat, opt_state.adam.mu, opt_state.adam.nu,
+            leaf, g_flat, p_flat, mu_in, nu_in,
             t_flat, lm_flat, wm_flat, is_last_layer, scale_tree,
         )
         outs = jax.tree.transpose(
@@ -449,6 +465,10 @@ def make_sharded_update(
             jax.tree.structure(tuple(range(n_out))),
             fused,
         )
+        # closing fusion cut (comment above): the consumers — per-leaf
+        # unflatten here, bucket re-pack in the bucketed engine — stay
+        # out of the shared math subgraph
+        outs = jax.lax.optimization_barrier(outs)
         if ema:
             p_new_flat, new_mu, new_nu, t_new_flat = outs
             new_teacher = jax.tree.map(from_shard, t_new_flat, teacher)
@@ -647,6 +667,764 @@ def make_sharded_update_schedule(
         new_params = jax.tree.map(unflatten_update_leaf, p_full, params)
         new_teacher = (jax.tree.map(unflatten_update_leaf, t_full, teacher)
                        if ema else teacher)
+        new_opt_state = ScheduledAdamWState(
+            count=opt_state.count + 1,
+            adam=optax.ScaleByAdamState(
+                count=_safe_int32_increment(opt_state.adam.count),
+                mu=new_mu, nu=new_nu,
+            ),
+        )
+        return new_params, new_teacher, new_opt_state, norms
+
+    return schedule
+
+
+# ---------------- bucketed collective engine ----------------
+#
+# The per-leaf sharded schedule above prices the ViT-L update phase at
+# one reduce-scatter per leaf + two all-gathers per leaf (COST_SHUP_r10:
+# 357 RS + 714 AG) — small-message latency-bound at production mesh
+# sizes (PAPERS.md arxiv 2408.13356: sub-MiB collectives are dominated
+# by per-message launch cost, not wire bytes). The bucketed engine
+# (optim.bucketed_collectives, auto = on when the sharded update
+# engages; the per-leaf schedule stays the bitwise oracle behind
+# =false) coalesces the update-phase leaves into a small fixed set of
+# large flat BUCKETS — grouped by (submodel, dtype, param-group) so the
+# per-submodel clip norms and the last-layer lr never mix inside a
+# bucket — and issues ONE reduce-scatter per bucket for the grads and
+# ONE all-gather per bucket for the updated params (plus one for the
+# EMA'd teacher): the SimpleFSDP coalescing (arxiv 2411.00284) written
+# at the same level as make_sharded_update.
+#
+# The bucket layout is SHARD-INTERLEAVED: a bucket is the row-major
+# flattening of a [dp, S_b/dp] matrix whose row k holds, member by
+# member in tree order, each member leaf's k-th flat shard (the member
+# leaves are individually in their flatten_update_leaf padded form, so
+# every member's shard is exactly padded/dp elements and every column
+# range is dp-aligned). Two properties follow:
+#
+# * sharding the bucket over the data axes (the "bucket" rule) gives
+#   each replica row k — the SAME elements the per-leaf layout's shards
+#   hold, so a bucket reduce-scatter computes, segment for segment, the
+#   identical sums the per-leaf reduce-scatters compute;
+# * extracting one member from a dim-0-sharded bucket is a column slice
+#   of the [dp, S_b/dp] view — shard-LOCAL, no data movement — so the
+#   engine runs the per-leaf update math graph (scalar multipliers,
+#   per_submodel_norms, update_leaf_math per leaf) unchanged between
+#   the bucket-granular collectives, and the bucketed arm is BITWISE
+#   the per-leaf arm (pinned in tests/test_buckets.py).
+#
+# The adam moments are BORN in the bucket layout (bucketed_adam_zeros);
+# checkpoints always persist the per-leaf layout and convert at the
+# save/restore boundary (buckets_to_flat_tree / flat_tree_to_buckets —
+# pure index permutations, bitwise lossless both ways).
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketMember:
+    """One leaf's segment inside a bucket."""
+
+    index: int       # leaf index in the student tree's flatten order
+    path: str        # jax.tree_util.keystr of the leaf (diagnostics)
+    shape: tuple     # original leaf shape
+    size: int        # element count
+    padded: int      # padded_flat_size(size, dp) — the segment length
+    offset: int      # segment start (elements, dp-aligned)
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One coalesced flat bucket (layout comment above)."""
+
+    name: str                        # dict key of the bucket arrays
+    group: str                       # top-level submodel key (clip norms)
+    dtype: Any                       # numpy dtype of every member
+    is_last_layer: bool              # param-group bit (last-layer lr)
+    members: tuple                   # tuple[BucketMember, ...]
+    size: int                        # total flat elements (dp-aligned)
+
+    @property
+    def pad_elems(self) -> int:
+        return sum(m.padded - m.size for m in self.members)
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """The leaf -> bucket assignment for one student tree at one shard
+    count, built ONCE per training setup from the abstract params
+    (train/setup.py — the TelemetryPlan convention) and shared by the
+    engine, the opt-state init, the checkpoint adapter, the guardrail
+    and the census scripts.
+
+    Assembly rule (make_bucket_plan): leaves are walked in tree order,
+    grouped by (top-level submodel key, dtype, is-last-layer bit) —
+    submodels must not mix because the clip norms are per submodel,
+    dtypes must not mix because a bucket is one array, and the
+    last-layer lr schedule stays uniform per bucket — then packed
+    greedily into buckets of ~``target_bytes`` payload. A single leaf
+    larger than the target becomes its own bucket (leaves are never
+    split); a trailing bucket smaller than 1/8 of the target is merged
+    into its predecessor so greedy packing cannot strand a straggler
+    (configs/config.py warn_bucket_padding checks the built plan
+    anyway).
+    """
+
+    buckets: tuple                   # tuple[Bucket, ...]
+    treedef: Any                     # student tree structure
+    n_leaves: int
+    dp: int
+    target_bytes: int
+
+    @property
+    def names(self):
+        return [b.name for b in self.buckets]
+
+    def padding_stats(self):
+        """Per-bucket accounting rows for the guardrail + bench."""
+        return [
+            {
+                "name": b.name,
+                "group": b.group,
+                "dtype": str(jnp.dtype(b.dtype)),
+                "is_last_layer": bool(b.is_last_layer),
+                "n_leaves": len(b.members),
+                "elems": int(b.size),
+                "pad_elems": int(b.pad_elems),
+                "bytes": int(b.size) * jnp.dtype(b.dtype).itemsize,
+            }
+            for b in self.buckets
+        ]
+
+    # ---- layout conversions ----
+    #
+    # All four are pure index permutations built from reshape /
+    # column-slice / concatenate, so every direction is bitwise
+    # lossless; the checkpoint pair works on numpy arrays too.
+
+    def _leaves(self, tree):
+        leaves = jax.tree.leaves(tree)
+        if len(leaves) != self.n_leaves:
+            raise ValueError(
+                f"bucket plan built for {self.n_leaves} leaves, "
+                f"got a tree with {len(leaves)}"
+            )
+        return leaves
+
+    def _assemble(self, flat_parts, bucket):
+        # per-member flat [padded] -> interleaved bucket [S_b]
+        mats = [f.reshape(self.dp, -1) for f in flat_parts]
+        mat = mats[0] if len(mats) == 1 else jnp.concatenate(mats, axis=1)
+        return mat.reshape(-1)
+
+    def pack_tree(self, tree, constrain_fn=None):
+        """Model-layout tree -> {bucket_name: flat [S_b]} (each leaf
+        through its padded-flat form, then shard-interleaved into the
+        bucket). ``constrain_fn`` (e.g. ``constrain_bucket``) is applied
+        to each assembled bucket — under GSPMD that constraint is where
+        the ONE reduce-scatter per bucket lands."""
+        leaves = self._leaves(tree)
+        out = {}
+        for b in self.buckets:
+            flat = self._assemble(
+                [flatten_update_leaf(leaves[m.index], self.dp)
+                 for m in b.members], b)
+            out[b.name] = constrain_fn(flat) if constrain_fn else flat
+        return out
+
+    def pack_flat_tree(self, flat_tree, constrain_fn=None):
+        """Per-leaf flat padded tree (the per-leaf engine's working
+        layout) -> bucket layout."""
+        leaves = self._leaves(flat_tree)
+        out = {}
+        for b in self.buckets:
+            flat = self._assemble([leaves[m.index] for m in b.members], b)
+            out[b.name] = constrain_fn(flat) if constrain_fn else flat
+        return out
+
+    def unpack_flat_tree(self, bucket_dict, constrain_fn=None):
+        """Bucket layout -> per-leaf flat padded tree. On a
+        dim-0-sharded bucket every member extraction is a shard-local
+        column slice (layout comment above) — no data movement."""
+        out_leaves = [None] * self.n_leaves
+        for b in self.buckets:
+            mat = bucket_dict[b.name].reshape(self.dp, -1)
+            for m in b.members:
+                c0 = m.offset // self.dp
+                seg = mat[:, c0:c0 + m.padded // self.dp].reshape(-1)
+                out_leaves[m.index] = (constrain_fn(seg) if constrain_fn
+                                       else seg)
+        return jax.tree.unflatten(self.treedef, out_leaves)
+
+    def unpack_tree(self, bucket_dict, like_tree, prepare_fn=None):
+        """{bucket_name: flat [S_b]} -> model-layout tree.
+        ``prepare_fn`` (e.g. ``constrain_replicated`` — the
+        one-all-gather-per-bucket materialization point) is applied to
+        each bucket BEFORE the member slices."""
+        like_leaves = self._leaves(like_tree)
+        out_leaves = [None] * self.n_leaves
+        for b in self.buckets:
+            flat = bucket_dict[b.name]
+            if prepare_fn is not None:
+                flat = prepare_fn(flat)
+            mat = flat.reshape(self.dp, -1)
+            for m in b.members:
+                c0 = m.offset // self.dp
+                seg = mat[:, c0:c0 + m.padded // self.dp].reshape(-1)
+                out_leaves[m.index] = unflatten_update_leaf(
+                    seg, like_leaves[m.index])
+        return jax.tree.unflatten(self.treedef, out_leaves)
+
+    def buckets_to_flat_tree(self, bucket_dict):
+        """Bucket layout -> the PER-LEAF flat padded layout
+        (``sharded_adam_zeros`` shapes). The checkpoint adapter uses
+        this so on-disk moments are always per-leaf — a bucketed run's
+        checkpoint restores into any arm and vice versa. Numpy in ->
+        numpy out (the host-side restore path)."""
+        out_leaves = [None] * self.n_leaves
+        for b in self.buckets:
+            mat = bucket_dict[b.name].reshape(self.dp, -1)
+            for m in b.members:
+                c0 = m.offset // self.dp
+                out_leaves[m.index] = (
+                    mat[:, c0:c0 + m.padded // self.dp].reshape(-1))
+        return jax.tree.unflatten(self.treedef, out_leaves)
+
+    def flat_tree_to_buckets(self, flat_tree):
+        """Inverse of ``buckets_to_flat_tree``; numpy in -> numpy out."""
+        import numpy as np
+
+        leaves = self._leaves(flat_tree)
+        out = {}
+        for b in self.buckets:
+            mats = []
+            for m in b.members:
+                l = leaves[m.index]
+                if l.ndim != 1 or l.shape[0] != m.padded:
+                    raise ValueError(
+                        f"bucket plan expects per-leaf flat [{m.padded}] "
+                        f"for {m.path}, got {l.shape}"
+                    )
+                mats.append(l.reshape(self.dp, -1))
+            if all(isinstance(x, np.ndarray) for x in mats):
+                mat = (mats[0] if len(mats) == 1
+                       else np.concatenate(mats, axis=1))
+            else:
+                mat = (mats[0] if len(mats) == 1
+                       else jnp.concatenate(mats, axis=1))
+            out[b.name] = mat.reshape(-1)
+        return out
+
+
+def make_bucket_plan(
+    student: Any,
+    dp: int,
+    is_last_layer: Any = None,
+    target_bytes: int = 128 * 2 ** 20,
+) -> BucketPlan:
+    """Build the leaf -> bucket assignment (see ``BucketPlan``).
+
+    ``student``: the student param tree (abstract or concrete — only
+    paths/shapes/dtypes are read). ``is_last_layer``: the param-group
+    tree from ``build_multiplier_trees`` (None = no last-layer group).
+    """
+    import jax.tree_util as jtu
+
+    dp = max(1, int(dp))
+    flat, treedef = jtu.tree_flatten_with_path(student)
+    ll_leaves = (jax.tree.leaves(is_last_layer)
+                 if is_last_layer is not None else [False] * len(flat))
+    if len(ll_leaves) != len(flat):
+        raise ValueError(
+            f"is_last_layer tree has {len(ll_leaves)} leaves, "
+            f"student has {len(flat)}"
+        )
+
+    def top_key(path):
+        k = path[0]
+        return str(getattr(k, "key", getattr(k, "idx", k)))
+
+    # group key -> ordered member list (tree order preserved per group)
+    groups: dict = {}
+    for i, (path, leaf) in enumerate(flat):
+        key = (top_key(path), jnp.dtype(leaf.dtype).str,
+               bool(ll_leaves[i]))
+        n = leaf_size(leaf)
+        groups.setdefault(key, []).append(BucketMember(
+            index=i, path=jtu.keystr(path), shape=tuple(leaf.shape),
+            size=n, padded=padded_flat_size(n, dp), offset=0,
+        ))
+
+    buckets = []
+    for (group, dtype_str, is_ll), members in groups.items():
+        itemsize = jnp.dtype(dtype_str).itemsize
+        # greedy fill to the byte target; oversized leaves become
+        # single-member buckets (never split)
+        runs, run, run_bytes = [], [], 0
+        for m in members:
+            nbytes = m.padded * itemsize
+            if run and run_bytes + nbytes > target_bytes:
+                runs.append(run)
+                run, run_bytes = [], 0
+            run.append(m)
+            run_bytes += nbytes
+        if run:
+            runs.append(run)
+        # straggler rebalance: merge a tiny tail run into its
+        # predecessor so the assignment cannot strand a bucket under
+        # 1/8 of the target
+        if len(runs) >= 2 and sum(
+                m.padded for m in runs[-1]) * itemsize < target_bytes // 8:
+            runs[-2].extend(runs.pop())
+        for run in runs:
+            off, placed = 0, []
+            for m in run:
+                placed.append(dataclasses.replace(m, offset=off))
+                off += m.padded
+            buckets.append(Bucket(
+                name="", group=group, dtype=jnp.dtype(dtype_str),
+                is_last_layer=is_ll, members=tuple(placed), size=off,
+            ))
+
+    # deterministic global order (by first member's tree position) and
+    # zero-padded names so jax's sorted-dict-key traversal preserves it
+    buckets.sort(key=lambda b: b.members[0].index)
+    named = tuple(
+        dataclasses.replace(
+            b, name=f"b{i:03d}_{b.group}" + ("_ll" if b.is_last_layer
+                                             else ""))
+        for i, b in enumerate(buckets)
+    )
+    return BucketPlan(
+        buckets=named, treedef=treedef, n_leaves=len(flat), dp=dp,
+        target_bytes=int(target_bytes),
+    )
+
+
+def bucketed_adam_zeros(plan: BucketPlan) -> dict:
+    """Adam moment zeros BORN in the bucket layout, boxed with the
+    "bucket" logical axis for sharding derivation (the
+    ``sharded_adam_zeros`` convention — each replica stores 1/dp of
+    every bucket)."""
+    import flax.linen as nn
+
+    def z(b):
+        init = nn.with_logical_partitioning(
+            lambda: jnp.zeros((b.size,), b.dtype), ("bucket",))
+        return init()
+
+    return {b.name: z(b) for b in plan.buckets}
+
+
+def _check_bucketed_opt_state(opt_state, plan: BucketPlan) -> None:
+    if not isinstance(opt_state, ScheduledAdamWState):
+        raise TypeError(
+            "bucketed update engine requires the scheduled_adamw state, "
+            f"got {type(opt_state).__name__}"
+        )
+    mu = opt_state.adam.mu
+    if not isinstance(mu, dict) or set(mu) != set(plan.names):
+        raise TypeError(
+            "bucketed update engine requires the bucket-layout opt "
+            f"state (buckets {plan.names[:3]}...); init via "
+            "build_train_setup with optim.bucketed_collectives on, or "
+            "restore through Checkpointer with the setup's bucket_plan "
+            "(which adapts per-leaf/replicated checkpoints to buckets)"
+        )
+    for b in plan.buckets:
+        got = mu[b.name].shape
+        if got != (b.size,):
+            raise TypeError(
+                f"bucket {b.name}: mu shape {got}, expected ({b.size},)"
+            )
+
+
+def make_bucketed_update(
+    schedules: Schedules,
+    lr_mult: Any,
+    wd_mult: Any,
+    is_last_layer: Any,
+    mesh: Any,
+    plan: BucketPlan,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    clip_grad: float | None = None,
+    ema: bool = True,
+) -> Callable:
+    """Build the bucketed collective engine (section comment above).
+
+    Same contract as ``make_sharded_update`` except
+    ``opt_state.adam.mu/nu`` are {bucket_name: flat [S_b]} dicts in the
+    shard-interleaved bucket layout (``bucketed_adam_zeros``). The
+    per-leaf working forms BETWEEN the collectives — and therefore the
+    whole elementwise math graph: scalar multipliers,
+    ``per_submodel_norms``, ``update_leaf_math`` per leaf — are
+    identical to ``make_sharded_update``'s; only the collective
+    granularity changes. Grads are bucket-packed under the
+    ``bucket_pack`` named scope (where GSPMD places the ONE
+    reduce-scatter per bucket); the updated student/teacher are
+    bucket-packed and re-materialized under ``bucket_unpack`` (the ONE
+    all-gather per bucket site).
+    """
+    from dinov3_tpu.parallel.sharding import (
+        constrain_bucket,
+        constrain_replicated,
+        constrain_update_shard,
+        update_shard_size,
+    )
+
+    dp = update_shard_size(mesh)
+    if dp != plan.dp:
+        raise ValueError(f"plan built at dp={plan.dp}, mesh has dp={dp}")
+    lr_arr = jnp.asarray(schedules.lr, jnp.float32)
+    ll_lr_arr = jnp.asarray(schedules.last_layer_lr, jnp.float32)
+    wd_arr = jnp.asarray(schedules.weight_decay, jnp.float32)
+    do_clip = clip_grad is not None and clip_grad > 0
+    # gather whole buckets only on model-parallel-free meshes: with a
+    # tensor/seq/pipe/expert axis the member leaves carry model-parallel
+    # placements a replicated bucket would undo — the per-leaf
+    # unflatten + jit-level out_shardings then place the gathers, as in
+    # make_sharded_update
+    gather_whole = mesh is None or all(
+        int(mesh.shape.get(a, 1)) <= 1
+        for a in ("tensor", "seq", "pipe", "expert"))
+
+    def to_shard(x):
+        with jax.named_scope("update_shard_pack"):
+            return constrain_update_shard(flatten_update_leaf(x, dp), mesh)
+
+    def mult_to_shard(m, like):
+        if getattr(m, "ndim", 0) == 0:
+            return m
+        return to_shard(jnp.broadcast_to(m, like.shape).astype(jnp.float32))
+
+    def update(grads, params, teacher, opt_state, momentum):
+        _check_bucketed_opt_state(opt_state, plan)
+        i = jnp.minimum(opt_state.count, lr_arr.shape[0] - 1)
+        lr_t, ll_lr_t, wd_t = lr_arr[i], ll_lr_arr[i], wd_arr[i]
+        count_inc = _safe_int32_increment(opt_state.adam.count)
+        bc1 = 1 - b1 ** count_inc
+        bc2 = 1 - b2 ** count_inc
+
+        # grads: model layout -> ONE sharded bucket per group (the
+        # coalesced reduce-scatter) -> shard-local per-leaf flat views
+        with jax.named_scope("bucket_pack"):
+            g_bkt = plan.pack_tree(
+                grads, constrain_fn=lambda x: constrain_bucket(x, mesh))
+        g_flat = plan.unpack_flat_tree(
+            g_bkt, constrain_fn=lambda x: constrain_update_shard(x, mesh))
+        p_flat = jax.tree.map(to_shard, params)
+        t_flat = (jax.tree.map(to_shard, teacher) if ema
+                  else jax.tree.map(lambda _: jnp.float32(0.0), g_flat))
+        lm_flat = jax.tree.map(mult_to_shard, lr_mult, params)
+        wm_flat = jax.tree.map(mult_to_shard, wd_mult, params)
+        mu_flat = plan.unpack_flat_tree(opt_state.adam.mu)
+        nu_flat = plan.unpack_flat_tree(opt_state.adam.nu)
+        # fusion cut, mirroring make_sharded_update exactly: behind
+        # this barrier the norms + per-leaf update subgraph is the
+        # IDENTICAL graph over identically-shaped flat leaves — the
+        # bucket slices/concats would otherwise fuse into the math and
+        # vectorize it differently. Backends that honor the barrier as
+        # a fusion boundary compile the same kernels for both arms;
+        # XLA:CPU expands the barrier pre-fusion, where the moments and
+        # clip norms still stay bitwise (the interleaved layout fixes
+        # the reduction segments) and params/teacher sit within ~1-2
+        # ulp of the per-leaf arm (see make_sharded_update's comment).
+        (g_flat, p_flat, t_flat, lm_flat, wm_flat, mu_flat, nu_flat) = (
+            jax.lax.optimization_barrier(
+                (g_flat, p_flat, t_flat, lm_flat, wm_flat,
+                 mu_flat, nu_flat)))
+
+        norms = {}
+        if do_clip:
+            # the identical per_submodel_norms graph as the per-leaf
+            # engine, over identical flat sharded leaves
+            norms = per_submodel_norms(g_flat)
+            scales = {
+                k: jnp.minimum(1.0, clip_grad / jnp.maximum(n, 1e-12))
+                for k, n in norms.items()
+            }
+            scale_tree = {
+                k: jax.tree.map(lambda _, s=scales[k]: s, sub)
+                for k, sub in g_flat.items()
+            }
+        else:
+            scale_tree = jax.tree.map(lambda _: _NO_CLIP, g_flat)
+
+        def leaf(g, p, mu, nu, t, lm, wm, is_ll, scale):
+            return update_leaf_math(
+                g, p, mu, nu, t, lm, wm, is_ll, scale,
+                lr_t, ll_lr_t, wd_t, bc1, bc2, b1, b2, eps, momentum, ema,
+            )
+
+        n_out = 4 if ema else 3
+        fused = jax.tree.map(
+            leaf, g_flat, p_flat, mu_flat, nu_flat,
+            t_flat, lm_flat, wm_flat, is_last_layer, scale_tree,
+        )
+        outs = jax.tree.transpose(
+            jax.tree.structure(g_flat),
+            jax.tree.structure(tuple(range(n_out))),
+            fused,
+        )
+        # closing fusion cut (comment above)
+        outs = jax.lax.optimization_barrier(outs)
+        if ema:
+            p_new_flat, new_mu, new_nu, t_new_flat = outs
+        else:
+            p_new_flat, new_mu, new_nu = outs
+
+        # moments stay resident in the (sharded) bucket layout
+        with jax.named_scope("bucket_pack"):
+            mu_bkt = plan.pack_flat_tree(
+                new_mu, constrain_fn=lambda x: constrain_bucket(x, mesh))
+            nu_bkt = plan.pack_flat_tree(
+                new_nu, constrain_fn=lambda x: constrain_bucket(x, mesh))
+
+        # updated student/teacher: per-leaf shards -> ONE replicated
+        # bucket per group (the coalesced all-gather) -> model layout
+        def from_buckets(flat_tree, like):
+            with jax.named_scope("bucket_unpack"):
+                bkt = plan.pack_flat_tree(flat_tree)
+                return plan.unpack_tree(
+                    bkt, like,
+                    prepare_fn=lambda x: constrain_replicated(x, mesh))
+
+        def from_leaves(flat_tree, like):
+            with jax.named_scope("update_shard_unpack"):
+                return jax.tree.map(unflatten_update_leaf, flat_tree, like)
+
+        unpack = from_buckets if gather_whole else from_leaves
+        new_params = unpack(p_new_flat, params)
+        new_teacher = unpack(t_new_flat, teacher) if ema else teacher
+        new_opt_state = ScheduledAdamWState(
+            count=opt_state.count + 1,
+            adam=optax.ScaleByAdamState(
+                count=count_inc, mu=mu_bkt, nu=nu_bkt),
+        )
+        return new_params, new_teacher, new_opt_state, norms
+
+    return update
+
+
+def build_bucketed_update(
+    cfg, params: Any, schedules: Schedules, mesh: Any,
+    plan: BucketPlan, ema: bool = True,
+) -> Callable:
+    """Wire config -> multiplier trees -> bucketed engine
+    (``build_sharded_update``'s twin; same inputs, same validation,
+    plus the setup-built ``BucketPlan``)."""
+    lr_mult, wd_mult, is_last = build_multiplier_trees(
+        params,
+        layerwise_decay=cfg.optim.layerwise_decay,
+        patch_embed_lr_mult=cfg.optim.patch_embed_lr_mult,
+        dino_head_wd_multiplier=cfg.optim.dino_head_wd_multiplier,
+    )
+    if cfg.optim.optimizer != "adamw":
+        raise ValueError(
+            f"bucketed update engine supports adamw only, got "
+            f"{cfg.optim.optimizer!r}; set optim.bucketed_collectives="
+            f"false"
+        )
+    return make_bucketed_update(
+        schedules, lr_mult, wd_mult, is_last, mesh, plan,
+        b1=cfg.optim.adamw_beta1, b2=cfg.optim.adamw_beta2,
+        clip_grad=cfg.optim.clip_grad, ema=ema,
+    )
+
+
+def make_bucketed_update_schedule(
+    schedules: Schedules,
+    lr_mult: Any,
+    wd_mult: Any,
+    is_last_layer: Any,
+    mesh: Any,
+    plan: BucketPlan,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    clip_grad: float | None = None,
+    ema: bool = True,
+) -> Callable:
+    """The bucketed update schedule with EXPLICIT collectives — the
+    ``make_sharded_update_schedule`` convention for the bucketed
+    engine, compiled by scripts/cost_buckets.py for the committed
+    census (COST_BUCKET_r13.json).
+
+    Per bucket: the members' padded-flat partial grads are
+    shard-interleaved into the bucket layout and reduce-scattered with
+    ONE ``psum_scatter`` (scope ``bucket_pack``); because of the
+    interleave, each replica's [S_b/dp] reduce-scatter result is the
+    member-by-member concatenation of exactly the shards the per-leaf
+    schedule's reduce-scatters produce, so the body slices the members
+    back out LOCALLY and runs the per-leaf twin's own shard-local
+    program (per-leaf ``update_leaf_math``, per-submodel partial norms
+    + one small psum) unchanged; the updated student and EMA'd teacher
+    shards re-concatenate and come back with ONE ``all_gather`` per
+    bucket each (scope ``bucket_unpack``). Same signature as
+    ``make_sharded_update_schedule`` (stacked [dp, *leaf] grad
+    partials), ``opt_state`` in the bucket layout.
+    """
+    from dinov3_tpu.parallel.context import shard_map_compat
+    from dinov3_tpu.parallel.sharding import (
+        UPDATE_SHARD_AXES,
+        update_shard_size,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    dp = update_shard_size(mesh)
+    if dp != plan.dp:
+        raise ValueError(f"plan built at dp={plan.dp}, mesh has dp={dp}")
+    axes = tuple(a for a in UPDATE_SHARD_AXES if a in mesh.shape)
+    lr_arr = jnp.asarray(schedules.lr, jnp.float32)
+    ll_lr_arr = jnp.asarray(schedules.last_layer_lr, jnp.float32)
+    wd_arr = jnp.asarray(schedules.weight_decay, jnp.float32)
+    do_clip = clip_grad is not None and clip_grad > 0
+    shard_spec, rep_spec = P(axes), P()
+
+    def schedule(grad_partials, params, teacher, opt_state, momentum):
+        _check_bucketed_opt_state(opt_state, plan)
+        # flat padded shard-layout forms of everything the local body
+        # consumes per LEAF (identical to the per-leaf twin; only the
+        # grads and the updated outputs travel in bucket form)
+        p_flat = jax.tree.map(lambda p: flatten_update_leaf(p, dp), params)
+        t_flat = (jax.tree.map(lambda t: flatten_update_leaf(t, dp), teacher)
+                  if ema else jax.tree.map(lambda _: 0.0, grad_partials))
+        mults = jax.tree.map(
+            lambda m, p: m if getattr(m, "ndim", 0) == 0 else
+            flatten_update_leaf(
+                jnp.broadcast_to(m, p.shape).astype(jnp.float32), dp),
+            {"lm": lr_mult, "wm": wd_mult},
+            {"lm": params, "wm": params},
+        )
+        mults_spec = jax.tree.map(
+            lambda m: rep_spec if getattr(m, "ndim", 0) == 0 else shard_spec,
+            mults,
+        )
+        tf_spec = shard_spec if ema else rep_spec
+
+        def body(gp, pf, tf, mu, nu, ms, count, adam_count, mom):
+            i = jnp.minimum(count, lr_arr.shape[0] - 1)
+            lr_t, ll_lr_t, wd_t = lr_arr[i], ll_lr_arr[i], wd_arr[i]
+            count_inc = _safe_int32_increment(adam_count)
+            bc1 = 1 - b1 ** count_inc
+            bc2 = 1 - b2 ** count_inc
+            g_leaves = jax.tree.leaves(jax.tree.map(lambda g: g[0], gp))
+            # ONE reduce-scatter per bucket over the shard-interleaved
+            # concat of the members' padded-flat partial grads; row k of
+            # the interleave is the concat of the members' k-th shards,
+            # so the local result is the concat of the per-leaf
+            # reduce-scatter results, member by member
+            rs = {}
+            with jax.named_scope("bucket_pack"):
+                for b in plan.buckets:
+                    mats = [flatten_update_leaf(g_leaves[m.index], dp)
+                            .reshape(dp, -1) for m in b.members]
+                    mat = (mats[0] if len(mats) == 1
+                           else jnp.concatenate(mats, axis=1))
+                    rs[b.name] = jax.lax.psum_scatter(
+                        mat.reshape(-1), axes,
+                        scatter_dimension=0, tiled=True)
+            # member shards back out of the local bucket shards — a
+            # column slice of the interleave, local by construction
+            g_shard_leaves = [None] * plan.n_leaves
+            for b in plan.buckets:
+                for m in b.members:
+                    c0 = m.offset // dp
+                    g_shard_leaves[m.index] = (
+                        rs[b.name][c0:c0 + m.padded // dp])
+            g_shard = jax.tree.unflatten(plan.treedef, g_shard_leaves)
+            norms = {}
+            if do_clip:
+                partial = {
+                    k: sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                           for l in jax.tree.leaves(sub))
+                    for k, sub in g_shard.items()
+                }
+                norms = {k: jnp.sqrt(v)
+                         for k, v in jax.lax.psum(partial, axes).items()}
+                scale_tree = {
+                    k: jax.tree.map(
+                        lambda _, s=jnp.minimum(
+                            1.0, clip_grad / jnp.maximum(norms[k], 1e-12)
+                        ): s, sub)
+                    for k, sub in g_shard.items()
+                }
+            else:
+                scale_tree = jax.tree.map(lambda _: _NO_CLIP, g_shard)
+            def split_shards(bucket_dict):
+                # local [S_b/dp] bucket shards -> per-leaf local shards
+                # (plain slices: the interleave makes them contiguous)
+                leaves = [None] * plan.n_leaves
+                for b in plan.buckets:
+                    for m in b.members:
+                        c0 = m.offset // dp
+                        leaves[m.index] = (
+                            bucket_dict[b.name][c0:c0 + m.padded // dp])
+                return jax.tree.unflatten(plan.treedef, leaves)
+
+            mu_flat = split_shards(mu)
+            nu_flat = split_shards(nu)
+
+            def leaf(g, p, mu_l, nu_l, t, lm, wm, is_ll, scale):
+                return update_leaf_math(
+                    g, p, mu_l, nu_l, t, lm, wm, is_ll, scale,
+                    lr_t, ll_lr_t, wd_t, bc1, bc2, b1, b2, eps, mom, ema,
+                )
+
+            n_out = 4 if ema else 3
+            fused = jax.tree.map(
+                leaf, g_shard, pf, mu_flat, nu_flat, tf,
+                ms["lm"], ms["wm"], is_last_layer, scale_tree,
+            )
+            outs = jax.tree.transpose(
+                jax.tree.structure(g_shard),
+                jax.tree.structure(tuple(range(n_out))),
+                fused,
+            )
+            if ema:
+                p_new, new_mu, new_nu, t_new = outs
+            else:
+                p_new, new_mu, new_nu = outs
+
+            def cat_shards(flat_tree):
+                # per-leaf local shards -> local [S_b/dp] bucket shards
+                leaves = jax.tree.leaves(flat_tree)
+                return {
+                    b.name: (leaves[b.members[0].index]
+                             if len(b.members) == 1 else
+                             jnp.concatenate(
+                                 [leaves[m.index] for m in b.members]))
+                    for b in plan.buckets
+                }
+
+            # ONE all-gather per bucket (student, and teacher under ema)
+            with jax.named_scope("bucket_unpack"):
+                p_full = {k: jax.lax.all_gather(v, axes, tiled=True)
+                          for k, v in cat_shards(p_new).items()}
+                t_full = ({k: jax.lax.all_gather(v, axes, tiled=True)
+                           for k, v in cat_shards(t_new).items()}
+                          if ema else cat_shards(tf))
+            return (p_full, t_full, cat_shards(new_mu),
+                    cat_shards(new_nu), norms)
+
+        p_full, t_full, new_mu, new_nu, norms = shard_map_compat(
+            body, mesh=mesh,
+            in_specs=(shard_spec, shard_spec, tf_spec, shard_spec,
+                      shard_spec, mults_spec, rep_spec, rep_spec,
+                      rep_spec),
+            out_specs=(rep_spec, rep_spec, shard_spec, shard_spec,
+                       rep_spec),
+            check_vma=False,
+        )(grad_partials, p_flat, t_flat, opt_state.adam.mu,
+          opt_state.adam.nu, mults, opt_state.count,
+          opt_state.adam.count, momentum)
+
+        new_params = plan.unpack_tree(p_full, params)
+        new_teacher = (plan.unpack_tree(t_full, teacher) if ema
+                       else teacher)
         new_opt_state = ScheduledAdamWState(
             count=opt_state.count + 1,
             adam=optax.ScaleByAdamState(

@@ -94,6 +94,8 @@ class TrainSetup:
     fused_update: Callable | None = None  # single-pass engine, None = optax chain
     sharded_update: bool = False  # cross-replica sharded form of the engine
     zero3: bool = False  # ZeRO-3 weight-streaming layout (masters sharded)
+    bucketed: bool = False  # coalesced bucket form of the sharded engine
+    bucket_plan: Any = None  # the leaf->bucket assignment (BucketPlan)
     # lazy TelemetryPlan builder; None = telemetry.async_metrics=false
     # (the per-step-fetch oracle path is then the only metrics path)
     telemetry_builder: Callable | None = None
@@ -198,13 +200,82 @@ def build_train_setup(
             "1/dp shards); set sharded_update=false or re-enable "
             "fused_update"
         )
+    # Bucketed collective engine (optim.bucketed_collectives, auto = on):
+    # when the sharded update engages, coalesce its per-leaf schedule
+    # (one RS + two AGs per leaf) into one RS/AG per ~bucket_mb flat
+    # bucket (train/fused_update.py make_bucketed_update). The per-leaf
+    # engine stays the bitwise oracle behind =false.
+    from dinov3_tpu.configs.config import bucketed_collectives_wished
+
+    bucketed_raw = (cfg.get("optim") or {}).get(
+        "bucketed_collectives", "auto")
+    bucketed_explicit = (not isinstance(bucketed_raw, str)
+                         or bucketed_raw.lower() != "auto")
+    bucketed_wished = bucketed_collectives_wished(cfg)
+    if bucketed_explicit and bucketed_wished:
+        if use_zero3:
+            raise ValueError(
+                "optim.bucketed_collectives=true conflicts with "
+                "parallel.zero3: zero3 shards the masters along model "
+                "dims and runs the update shard-local — there is no "
+                "flat update-phase schedule to bucket. Set "
+                "optim.bucketed_collectives=auto (it yields to zero3) "
+                "or parallel.zero3=false."
+            )
+        if not fused_wished:
+            raise ValueError(
+                "optim.bucketed_collectives=true requires "
+                "optim.fused_update=true (the bucketed engine is the "
+                "fused single-pass math over bucket shards); re-enable "
+                "fused_update or set bucketed_collectives=false"
+            )
+        if sharded_explicit and not bool(sharded_wished):
+            raise ValueError(
+                "optim.bucketed_collectives=true requires the sharded "
+                "update path (optim.sharded_update=auto/true): the "
+                "buckets ARE the coalesced form of its flat "
+                "update_shard layout. Unset sharded_update=false or "
+                "set bucketed_collectives=false."
+            )
+    use_bucketed = (bucketed_wished and use_sharded)
+    use_sharded = use_sharded and not use_bucketed
+    bucket_plan = None
     if fused_wished:
         from dinov3_tpu.train.fused_update import (
+            build_bucketed_update,
             build_fused_update,
             build_sharded_update,
         )
 
-        if use_sharded:
+        if use_bucketed:
+            # the leaf -> bucket assignment, built ONCE per setup from
+            # the abstract params (the TelemetryPlan convention) and
+            # shared by the engine, the opt-state init, the checkpoint
+            # adapter and the census scripts
+            from dinov3_tpu.configs.config import warn_bucket_padding
+            from dinov3_tpu.train.fused_update import make_bucket_plan
+            from dinov3_tpu.train.param_groups import (
+                build_multiplier_trees,
+            )
+
+            _, _, is_last = build_multiplier_trees(
+                abstract_params["student"],
+                layerwise_decay=cfg.optim.layerwise_decay,
+                patch_embed_lr_mult=cfg.optim.patch_embed_lr_mult,
+                dino_head_wd_multiplier=cfg.optim.dino_head_wd_multiplier,
+            )
+            target_bytes = int(
+                (cfg.get("optim") or {}).get("bucket_mb", 128)) * 2 ** 20
+            bucket_plan = make_bucket_plan(
+                abstract_params["student"], dp, is_last_layer=is_last,
+                target_bytes=target_bytes,
+            )
+            warn_bucket_padding(bucket_plan.padding_stats(), target_bytes)
+            fused = build_bucketed_update(
+                cfg, abstract_params["student"], schedules, mesh,
+                bucket_plan, ema=not meta.distillation,
+            )
+        elif use_sharded:
             fused = build_sharded_update(
                 cfg, abstract_params["student"], schedules, mesh,
                 ema=not meta.distillation,
@@ -231,7 +302,23 @@ def build_train_setup(
         # mu/nu trees inherit the logical-axis boxes — one eval_shape
         # covers params and optimizer state.
         opt_state = optimizer.init(params["student"])
-        if use_sharded:
+        if use_bucketed:
+            # the bucketed engine's moments are BORN in the bucket
+            # layout ({bucket_name: flat [S_b]}, 1/dp per replica via
+            # the "bucket" logical rule) — same ScheduledAdamWState
+            # pytree, bucket-dict mu/nu
+            import optax
+
+            from dinov3_tpu.train.fused_update import bucketed_adam_zeros
+
+            opt_state = opt_state._replace(
+                adam=optax.ScaleByAdamState(
+                    count=opt_state.adam.count,
+                    mu=bucketed_adam_zeros(bucket_plan),
+                    nu=bucketed_adam_zeros(bucket_plan),
+                )
+            )
+        elif use_sharded:
             # the sharded engine's moments are BORN in the flat
             # "update_shard" layout (1/dp per replica, ZeRO-1) — same
             # ScheduledAdamWState pytree, flat padded mu/nu leaves
@@ -377,6 +464,7 @@ def build_train_setup(
         optimizer=optimizer, state=state, state_shardings=state_shardings,
         step_fn=step_fn, batch_shardings=b_shardings, fused_update=fused,
         sharded_update=use_sharded, zero3=use_zero3,
+        bucketed=use_bucketed, bucket_plan=bucket_plan,
         telemetry_builder=telemetry_builder,
     )
 

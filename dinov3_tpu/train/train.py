@@ -191,6 +191,10 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         example = {k: jnp.asarray(v) for k, v in first.items()}
     t0 = time.perf_counter()
     setup = build_train_setup(cfg, example, devices=devices)
+    # the bucketed collective engine keeps adam moments in the bucket
+    # layout; the checkpointer needs the plan to convert to/from the
+    # per-leaf on-disk layout (checkpoint.py)
+    ckpt.bucket_plan = getattr(setup, "bucket_plan", None)
     logger.info(
         "mesh %s | global batch %d | %d devices x %d hosts | setup %.1fs",
         dict(setup.mesh.shape), B, n_devices, world, time.perf_counter() - t0,

@@ -299,6 +299,13 @@ def classify_copy(line: str) -> str:
       _zero3_stream_trans_in, models/streaming.py) — the layout traffic
       weight streaming introduces, named so the census ceiling
       attributes it instead of absorbing it into "small"/"large".
+    - "bucket": copies inside the bucketed collective engine's
+      concat/slice walk (the ``bucket_pack``/``bucket_unpack`` named
+      scopes in train/fused_update.py make_bucketed_update, and the
+      ``bucket_gather``/``bucket_prefetch``/``bucket_stream`` scopes of
+      the overlap twin in models/streaming.py) — the leaf→bucket
+      assembly traffic coalescing introduces, named for the same reason
+      as "update_shard".
     - "rng": u32 results of <= 8 elements — threefry key/counter
       plumbing (keys are u32[2]/u32[4]; fold_in intermediates scalar).
     - "small": any other result of <= 1024 elements (scalar metrics,
@@ -317,6 +324,10 @@ def classify_copy(line: str) -> str:
     if ("zero3_gather" in line or "zero3_stream" in line
             or "zero3_prefetch" in line):
         return "zero3"
+    if ("bucket_pack" in line or "bucket_unpack" in line
+            or "bucket_gather" in line or "bucket_prefetch" in line
+            or "bucket_stream" in line):
+        return "bucket"
     shp = _hlo_result_shape(line)
     if shp is None:
         return "small"
@@ -423,6 +434,16 @@ HLO_COLLECTIVE_SCOPES = (
     ("zero3_prefetch", "zero3_prefetch"),
     ("zero3_stream", "zero3_stream"),
     ("zero3_gather", "zero3_gather"),
+    # the bucketed collective engine (train/fused_update.py
+    # make_bucketed_update + the overlap twin in models/streaming.py):
+    # pack = the coalesced grad reduce-scatter site, unpack = the
+    # one-all-gather-per-bucket param/teacher re-materialization,
+    # prefetch/gather/stream = the double-buffered bucket gather scan
+    ("bucket_prefetch", "bucket_prefetch"),
+    ("bucket_stream", "bucket_stream"),
+    ("bucket_gather", "bucket_gather"),
+    ("bucket_pack", "bucket_pack"),
+    ("bucket_unpack", "bucket_unpack"),
     ("update_shard", "update_shard"),
     ("crop_pack", "gather_pack"),
     ("crop_unpack", "gather_pack"),
@@ -439,6 +460,59 @@ def classify_collective_scope(line: str) -> str:
         if marker in line:
             return cat
     return "other"
+
+
+def collective_size_bin(nbytes: int) -> tuple[int, str]:
+    """Power-of-two message-size bin for one collective result.
+
+    Returns ``(floor_bytes, label)``: the largest power of two
+    <= ``nbytes`` and a human-readable half-open interval label
+    ("[64MiB,128MiB)"; zero-byte results bin as ``(0, "0B")``). The
+    census histograms collective traffic by these bins — the
+    small-message latency-bound regime (hundreds of per-leaf
+    collectives under 1 MiB) and the coalesced bucket regime (a few
+    >= 64 MiB messages) then read directly off the bin keys.
+    """
+    n = int(nbytes)
+    if n <= 0:
+        return 0, "0B"
+    floor = 1 << (n.bit_length() - 1)
+
+    def fmt(v: int) -> str:
+        for shift, unit in ((30, "GiB"), (20, "MiB"), (10, "KiB")):
+            if v >= (1 << shift):
+                scaled = v / (1 << shift)
+                return (f"{int(scaled)}{unit}" if scaled == int(scaled)
+                        else f"{scaled:g}{unit}")
+        return f"{v}B"
+
+    return floor, f"[{fmt(floor)},{fmt(floor * 2)})"
+
+
+def hlo_collective_placement(line: str) -> str:
+    """Issue-site placement of one collective HLO instruction, from its
+    op_name metadata (the while-loop signal ``hlo_collective_in_loop``
+    reads, split by pass direction):
+
+    - "in-backward-loop": inside a compiled loop body AND on the
+      transposed (backward) path — jax stamps backward-pass ops with a
+      ``transpose(...)`` component in their op_name, which survives
+      partitioning. A reduce-scatter here is a grad sync issued as the
+      backward loop produces each bucket/block — overlappable with the
+      remaining backward compute.
+    - "in-forward-loop": inside a loop body on the forward path (the
+      per-block / per-bucket weight-stream gathers).
+    - "at-barrier": outside any loop — a whole-tree materialization or
+      an update-phase collective issued after both passes complete
+      (nothing left to overlap it with).
+    """
+    import re
+
+    m = re.search(r'op_name="([^"]*)"', line)
+    op = m.group(1) if m else ""
+    if "while" in op:
+        return "in-backward-loop" if "transpose" in op else "in-forward-loop"
+    return "at-barrier"
 
 
 def hlo_collective_in_loop(line: str) -> bool:
@@ -475,22 +549,56 @@ def hlo_collective_census(hlo_text: str) -> dict:
     ``zero3_prefetch`` scope — the double-buffered schedule), and how
     many are issued at use (``zero3_stream``; overlap is then the async
     scheduler's job). The zero3 acceptance pins read these columns.
+
+    Two further columns (the bucketed-collective acceptance pins,
+    COST_BUCKET_r13.json):
+
+    - ``size_histogram`` (top-level, and a per-class copy inside each
+      ``by_class`` entry): count + bytes per power-of-two message-size
+      bin (``collective_size_bin``). The per-leaf schedules show
+      hundreds of sub-MiB entries; the bucketed engine a handful of
+      >= 64 MiB ones — each bin entry carries its ``floor_bytes`` so
+      pins read thresholds without parsing labels.
+    - ``by_placement`` (top-level + per class): ops/bytes per issue
+      site (``hlo_collective_placement``) — in-backward-loop /
+      in-forward-loop / at-barrier. The overlap-scheduled engine's grad
+      reduce-scatters attribute to the backward loop body; the per-leaf
+      update-phase schedule is all at-barrier.
     """
     by_class: dict = {}
     by_scope: dict = {}
+    by_placement: dict = {}
+    size_histogram: dict = {}
     ag_in_loop_ops = ag_in_loop_bytes = 0
     ag_prefetch = ag_at_use = 0
     total_ops = 0
     total_bytes = 0
+
+    def _bump_hist(hist: dict, nbytes: int) -> None:
+        floor, label = collective_size_bin(nbytes)
+        h = hist.setdefault(
+            label, {"floor_bytes": floor, "ops": 0, "bytes": 0})
+        h["ops"] += 1
+        h["bytes"] += nbytes
+
     for line in hlo_non_fusion_lines(hlo_text):
         cat = classify_collective(line)
         if cat is None:
             continue
         shp = _hlo_result_shape(line)
         nbytes = shp[2] if shp else 0
-        ent = by_class.setdefault(cat, {"ops": 0, "bytes": 0})
+        ent = by_class.setdefault(
+            cat, {"ops": 0, "bytes": 0,
+                  "size_histogram": {}, "by_placement": {}})
         ent["ops"] += 1
         ent["bytes"] += nbytes
+        _bump_hist(ent["size_histogram"], nbytes)
+        _bump_hist(size_histogram, nbytes)
+        placement = hlo_collective_placement(line)
+        for tbl in (ent["by_placement"], by_placement):
+            p_ent = tbl.setdefault(placement, {"ops": 0, "bytes": 0})
+            p_ent["ops"] += 1
+            p_ent["bytes"] += nbytes
         scope = classify_collective_scope(line)
         s_ent = by_scope.setdefault(scope, {"ops": 0, "bytes": 0})
         s_ent["ops"] += 1
@@ -510,6 +618,8 @@ def hlo_collective_census(hlo_text: str) -> dict:
         "hlo_collective_bytes": total_bytes,
         "by_class": by_class,
         "by_scope": by_scope,
+        "by_placement": by_placement,
+        "size_histogram": size_histogram,
         "prefetch_overlap": {
             "all_gather_in_loop_ops": ag_in_loop_ops,
             "all_gather_in_loop_bytes": ag_in_loop_bytes,

@@ -71,6 +71,22 @@
 #         record also embeds its own measure-loop fetch count +
 #         host-blocked ms ("telemetry" field). This measures what the
 #         TPU dispatch pipeline does with each form.
+#   phB   bucketed overlap-scheduled collective engine A/B (the
+#         per-leaf collective-launch attack, train/fused_update.py
+#         make_bucketed_update): treatment pins the bucketed engine on
+#         (optim.bucketed_collectives=true — 357 per-leaf grad
+#         reduce-scatters coalesced into ~14 flat-bucket RS, 714
+#         param/teacher all-gathers into ~28 bucket AG, shard-
+#         interleaved layout so the reduction path stays bitwise);
+#         control strips ONLY the engine (=false, the per-leaf PR-5
+#         schedule), same scanned stack on both arms. Both arms carry
+#         the copy + collective censuses (BENCH_CENSUS=1) so the
+#         RS/AG op-count collapse and the size histogram (the >=64MB
+#         big-bin fraction, COST_BUCKET_r13.json: 9% -> 90% of bytes)
+#         land in the same JSONL row as the throughput delta — this
+#         measures whether the TPU's collective scheduler actually
+#         prices 25x fewer, 10x larger launches the way the host-side
+#         accounting says it should.
 #   phG2  fixed op-level flash-vs-dense attention crossover
 #         (scripts/crossover_attention.py): the
 #         kernels.flash_min_seq=2048 boundary is measured only at
@@ -243,6 +259,21 @@ run_bench phW_zero3_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=parallel.zero3=true,train.scan_layers=true
 run_bench phW_zero3_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=parallel.zero3=false,train.scan_layers=true
+
+# phB: bucketed overlap-scheduled collective engine A/B. Treatment
+# pins the bucketed engine on (coalesced flat-bucket grad RS under
+# backward + bucketed param/teacher AG — optim.bucketed_collectives
+# auto-engages only on pure-dp meshes, so the pin keeps the arm honest
+# whatever mesh the bench ladder lands on); control strips ONLY the
+# engine (=false, the per-leaf PR-5 schedule), same scanned stack.
+# Both arms carry the copy + collective censuses so the RS/AG launch
+# collapse (357 -> 14 / 714 -> 28 at ViT-L dp=8, COST_BUCKET_r13.json)
+# and the >=64MB big-bin bytes fraction land next to the throughput
+# delta.
+run_bench phB_bucketed_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=optim.bucketed_collectives=true,train.scan_layers=true
+run_bench phB_bucketed_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=optim.bucketed_collectives=false,train.scan_layers=true
 
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including

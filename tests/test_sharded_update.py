@@ -211,8 +211,10 @@ def _setup(extra, batch_size, eight_devices):
     from dinov3_tpu.train import build_train_setup
 
     # pin the PR-5 flat engine arms: zero3 (PR 7) otherwise auto-takes
-    # the fsdp>1 meshes and swaps the moment layout this file pins
-    cfg = smol_cfg(["parallel.zero3=false"] + list(extra))
+    # the fsdp>1 meshes, and the bucketed engine (PR 9) otherwise
+    # auto-supersedes the per-leaf schedule this file pins
+    cfg = smol_cfg(["parallel.zero3=false",
+                    "optim.bucketed_collectives=false"] + list(extra))
     batch = {k: jnp.asarray(v) for k, v in
              make_synthetic_batch(cfg, batch_size, seed=0).items()}
     return build_train_setup(cfg, batch, devices=eight_devices), batch

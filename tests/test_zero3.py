@@ -73,7 +73,11 @@ def _setup(extra, batch_size, devices):
     from dinov3_tpu.train import build_train_setup
 
     cfg = get_default_config()
-    apply_dot_overrides(cfg, SMOL + list(extra))
+    # pin the bucketed engine (PR 9) off: this file pins the zero3-vs-
+    # PR-5-flat arm topology, and bucketed otherwise auto-supersedes
+    # the flat engine's slot on dp-only meshes
+    apply_dot_overrides(
+        cfg, SMOL + ["optim.bucketed_collectives=false"] + list(extra))
     batch = {k: jnp.asarray(v) for k, v in
              make_synthetic_batch(cfg, batch_size, seed=0).items()}
     return build_train_setup(cfg, batch, devices=devices), batch
