@@ -401,6 +401,40 @@ def _bucket_summary(setup, coll_census) -> dict:
     return out
 
 
+def _serve_summary(engine, copy_census=None) -> dict:
+    """The record's "serve" block: arm, token-budget shape, measured
+    pad waste (mean over all packs since the arm's last
+    ``reset_pad_stats``, plus the last pack's — usually a partial
+    trailing pack), and the blocking_fetch funnel counters (fetch count
+    + host-blocked ms) since the last arm boundary.
+    scripts/bench_serve.py embeds one per (arm, mix) record in
+    SERVE_r14.json; (census runs only) the serve-scoped copy counts of
+    the packed program land alongside."""
+    from dinov3_tpu.telemetry.host_sync import host_sync_stats
+
+    L = engine.layout
+    mean_waste = getattr(engine, "mean_pad_waste", None)
+    out = {
+        "arm": engine.arm,
+        "rows": L.rows,
+        "row_tokens": L.row_tokens,
+        "token_budget": L.token_budget,
+        "pad_waste": (round(mean_waste, 4)
+                      if mean_waste is not None else None),
+        "pad_waste_last_pack": (round(engine.last_pad_waste, 4)
+                                if engine.last_pad_waste is not None
+                                else None),
+        "compile_count": engine.compile_count,
+        "host_sync": host_sync_stats(reset=True),
+    }
+    if copy_census and "by_category" in copy_census:
+        by_cat = copy_census["by_category"]
+        out["serve_copies"] = by_cat.get("serve", {}).get("ops", 0)
+        out["unattributed_copies"] = by_cat.get(
+            "unattributed", {}).get("ops", 0)
+    return out
+
+
 _CURRENT_CHILD = {"proc": None}
 
 

@@ -575,6 +575,77 @@ def warn_telemetry_flush_period(
     return msg
 
 
+def continuous_packing_wished(cfg: ConfigNode) -> bool:
+    """Whether the config ASKS for the continuous-packing serve engine
+    (serve/engine.py PackedServeEngine). ``serve.continuous_packing``:
+    auto/true (default) = the packed engine; false = the naive
+    shape-polymorphic oracle arms (``serve.oracle`` picks per_image or
+    rectangular)."""
+    cp = (cfg.get("serve") or {}).get("continuous_packing", "auto")
+    if isinstance(cp, str):
+        return cp.lower() in ("auto", "true", "on")
+    return bool(cp)
+
+
+def serve_pad_waste_floor(
+    row_tokens: int, patch_size: int, n_prefix: int,
+    min_px: int, max_px: int,
+) -> dict:
+    """Worst-case per-row pad waste over the serve resolution envelope.
+
+    For a square resolution r (a multiple of ``patch_size``) the image
+    spans ``L_r = n_prefix + (r/p)^2`` tokens; a row fits
+    ``floor(row_tokens / L_r)`` such images and wastes the remainder.
+    The floor scans every admissible r in [min_px, max_px] and returns
+    the worst ``{"px", "seq_len", "waste"}`` — the waste a traffic mix
+    concentrated at that resolution could not pack below, whatever the
+    batcher does — plus ``"mean_waste"``, the same floor averaged
+    uniformly over the envelope. The build-time guardrail keys on the
+    mean (a worst single resolution is an adversarial mix, not a config
+    bug); bench_serve.py re-checks each MEASURED mix against its real
+    waste. Build-time input to ``warn_serve_pad_waste``."""
+    worst = {"px": min_px, "seq_len": 0, "waste": 0.0}
+    wastes = []
+    for px in range(min_px, max_px + 1, patch_size):
+        if px % patch_size:
+            continue
+        seq = n_prefix + (px // patch_size) ** 2
+        if seq > row_tokens:
+            continue
+        waste = 1.0 - (row_tokens // seq) * seq / row_tokens
+        wastes.append(waste)
+        if waste > worst["waste"]:
+            worst = {"px": px, "seq_len": seq, "waste": waste}
+    worst["mean_waste"] = sum(wastes) / len(wastes) if wastes else 0.0
+    return worst
+
+
+def warn_serve_pad_waste(
+    pad_waste: float, threshold: float = 0.15, stacklevel: int = 2,
+    axis: str = "serve token budget",
+) -> str | None:
+    """Warn when a serve traffic mix (or the envelope's static floor)
+    wastes more than ``threshold`` of the token budget on padding — the
+    axis-labelled guardrail style of ``warn_bucket_padding``. Fired at
+    engine build (serve/engine.py, with the ``serve_pad_waste_floor``
+    envelope scan) and per measured mix by ``scripts/bench_serve.py``
+    (recorded in SERVE_r14.json). Returns the message or None."""
+    if pad_waste <= threshold:
+        return None
+    msg = (
+        f"serve pad-waste axis [{axis}]: {pad_waste:.1%} of the packed "
+        f"token budget is padding (> {threshold:.0%}) — the compiled "
+        f"serve step spends that fraction of its FLOPs on masked-out "
+        f"tokens. Resize serve.row_tokens / serve.rows to the traffic's "
+        f"token distribution, or tighten the serve.min_px..max_px "
+        f"envelope (serve/batcher.py)."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
+
+
 def apply_scaling_rules_to_cfg(cfg: ConfigNode) -> ConfigNode:
     """Batch-size lr scaling, resolved once at load time.
 

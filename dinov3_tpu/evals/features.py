@@ -5,6 +5,18 @@
 ``dinov3.*`` modules (models/__init__.py:81-93, SURVEY.md §2.2). This is
 the working harness: one jitted forward per (batch-shape), features
 gathered to host as float32.)
+
+Two ragged-traffic regimes, two fixes:
+
+- A dataset whose length is not a multiple of the batch size ends with
+  one partial batch. Naively feeding it re-traces ``feat`` for the tail
+  shape — one full XLA compile to serve a handful of rows.
+  ``extract_features`` instead pads the tail up to the first batch's
+  row count, runs the SAME compiled program, and slices the pad rows
+  off on host (tests/test_serve.py pins the compile count at 1).
+- Genuinely variable-resolution traffic (every image its own H×W) is
+  the serve engine's job: ``extract_features_serve`` rides
+  ``serve.PackedServeEngine`` — one fixed-shape compile for every mix.
 """
 
 from __future__ import annotations
@@ -35,18 +47,64 @@ def extract_features(
     params,
     batches: Iterator[dict],
     max_batches: int | None = None,
+    feat: Callable | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """batches: dicts with "image" [B, H, W, 3] and "label" [B].
 
-    Returns (features [N, D] f32, labels [N] i64) on host.
+    Returns (features [N, D] f32, labels [N] i64) on host. A smaller
+    final batch (the ragged dataset tail) is zero-padded to the first
+    batch's row count and run through the same compiled program — the
+    pad rows are sliced off before concatenation, so a ragged tail
+    costs copies, not a recompile. ``feat``: pass an existing jitted
+    feature fn to share its cache across datasets (tests pin its
+    compile count through this handle).
     """
-    feat = make_feature_fn(model, params)
+    if feat is None:
+        feat = make_feature_fn(model, params)
     feats, labels = [], []
+    lead_rows: int | None = None
     for i, batch in enumerate(batches):
         if max_batches is not None and i >= max_batches:
             break
-        feats.append(np.asarray(feat(jnp.asarray(batch["image"]))))
+        image = np.asarray(batch["image"])
+        n = image.shape[0]
+        if lead_rows is None:
+            lead_rows = n
+        if n < lead_rows:
+            pad = np.zeros((lead_rows - n, *image.shape[1:]), image.dtype)
+            image = np.concatenate([image, pad])
+        feats.append(np.asarray(feat(jnp.asarray(image)))[:n])
         labels.append(np.asarray(batch["label"]))
     if not feats:
         raise ValueError("no batches to extract features from")
     return np.concatenate(feats), np.concatenate(labels)
+
+
+def extract_features_serve(
+    engine,
+    images: Iterator[np.ndarray],
+    labels: Iterator[int] | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Variable-resolution extraction through a serve engine.
+
+    ``images`` yields [H, W, 3] float arrays of ANY admissible
+    resolution (each its own shape); features come back through the
+    engine's single packed forward in submission order. Returns
+    (cls features [N, D] f32, labels [N] i64 — zeros when ``labels`` is
+    None). The batch-shaped path above compiles once per batch shape;
+    this path compiles once, period.
+    """
+    n = 0
+    for i, image in enumerate(images):
+        engine.submit(np.asarray(image), request_id=i)
+        n += 1
+    if n == 0:
+        raise ValueError("no images to extract features from")
+    responses = []
+    while engine.queue_len:
+        responses.extend(engine.flush())
+    responses.sort(key=lambda r: r.request_id)
+    feats = np.stack([r.cls_feature for r in responses])
+    lab = (np.asarray(list(labels), np.int64) if labels is not None
+           else np.zeros((n,), np.int64))
+    return feats, lab

@@ -196,6 +196,8 @@ def build_model_for_eval(cfg: ConfigNode, ckpt_dir: str | None = None):
     if ckpt_dir:
         import orbax.checkpoint as ocp
 
+        from ..checkpoint import pytree_restore_args
+
         with ocp.CheckpointManager(ckpt_dir) as manager:
             step = manager.latest_step()
             if step is None:
@@ -204,9 +206,8 @@ def build_model_for_eval(cfg: ConfigNode, ckpt_dir: str | None = None):
             restored = manager.restore(
                 step,
                 args=ocp.args.Composite(
-                    state=ocp.args.PyTreeRestore(
-                        {"params": {"teacher": {"backbone": abstract}}},
-                        partial_restore=True,
+                    state=pytree_restore_args(
+                        {"params": {"teacher": {"backbone": abstract}}}
                     )
                 ),
             )

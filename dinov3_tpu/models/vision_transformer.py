@@ -516,6 +516,91 @@ class DinoVisionTransformer(nn.Module):
         return rope_packed_rows(g_table, l_table, layout)
 
     @nn.compact
+    def packed_feature_forward(self, patches, coords, prefix_idx, seg):
+        """Serving-time forward over host-packed multi-image planes.
+
+        The continuous-packing serve engine (serve/engine.py) admits
+        variable-resolution images into fixed token-budget rows on the
+        host; this method is the ONE fixed-shape device program those
+        rows run through — deterministic (no student rng plan, no
+        drop-path, no RoPE augmentation), segment-masked like the
+        crop-packed trainer (``_packed_forward``), with per-TOKEN RoPE
+        computed in-program from a host coordinate plane because packed
+        segments carry arbitrary (h, w) patch grids rather than the
+        trainer's two static crop resolutions.
+
+        patches: [R, N, p, p, C] host-patchified pixels (zeros at
+          prefix/pad slots) — each [p, p, C] patch keeps PatchEmbed's
+          row-major inner layout, so embedding them as R*N single-patch
+          images through the SAME PatchEmbed module reproduces the
+          full-image unfold+matmul bitwise (ops/patch_embed.py).
+        coords: [R, N, 2] f32 patch-center coordinates in [-1, 1]
+          (ops/rope.py patch_coords math per segment); zeros at
+          prefix/pad slots — angle 0 is sin 0 / cos 1, the identity
+          rotation ``rope_with_identity_prefix`` gives prefix tokens.
+        prefix_idx: [R, N] int32 — 0 = the slot holds the CLS token,
+          s in [1, S] = storage token s-1, -1 = patch or pad slot.
+        seg: [R, N] int32 segment ids, -1 = pad (ops/packing.py
+          conventions: pads attend only among themselves).
+
+        Returns {"cls_rows": [R, N, D], "patch_rows": [R, N, D]} — the
+        block-stack output normed with the CLS norm and the patch norm
+        respectively (the ``_final_norms`` crop_kind="global"
+        deterministic selection; norms are per-token, so norming the
+        full plane and extracting per segment afterwards equals the
+        oracle's extract-then-norm). Per-segment CLS/pooled-patch
+        extraction happens engine-side (serve_extract named scope).
+        """
+        patch_embed, _, cls_token, storage = self._token_embedder()
+        norms = self._make_norms()
+        R, N = seg.shape
+        p, C = self.patch_size, self.in_chans
+        with jax.named_scope("serve_pack"):
+            tok = patch_embed(patches.reshape(R * N, p, p, C))
+            tok = tok.reshape(R, N, self.embed_dim)
+            # zero the pad slots (PatchEmbed of a zero patch is the
+            # bias vector, not zero) and inject the prefix params
+            is_prefix = prefix_idx >= 0
+            tok = jnp.where((seg >= 0)[..., None] & ~is_prefix[..., None],
+                            tok, jnp.zeros((), tok.dtype))
+            table = cls_token[0]
+            if storage is not None:
+                table = jnp.concatenate([table, storage[0]], axis=0)
+            pre = jnp.take(table.astype(tok.dtype),
+                           jnp.clip(prefix_idx, 0, table.shape[0] - 1),
+                           axis=0)
+            tok = jnp.where(is_prefix[..., None], pre, tok)
+        rope = self._serve_rope(coords)
+        out, _ = self._run_blocks(tok, rope, True, seg=seg)
+        cls_norm = (norms["cls_norm"] if self.untie_cls_and_patch_norms
+                    else norms["norm"])
+        return {"cls_rows": cls_norm(out), "patch_rows": norms["norm"](out)}
+
+    def _serve_rope(self, coords):
+        """Per-token (sin, cos) tables ([R, N, head_dim] x2) from a host
+        coordinate plane — the same angle math as ``rope_sincos``
+        (elementwise over the same f32 values, so real patch
+        coordinates reproduce the oracle's table bitwise and zero
+        coordinates reproduce the identity prefix rows bitwise),
+        consumed by ``rope_apply_full``'s 3-D per-row path."""
+        if self.pos_embed_type != "rope":
+            return None
+        import math
+
+        periods = rope_periods(
+            self.head_dim,
+            base=self.pos_embed_rope_base,
+            min_period=self.pos_embed_rope_min_period,
+            max_period=self.pos_embed_rope_max_period,
+        )
+        angles = (2.0 * math.pi * coords[..., None]
+                  / periods[None, None, None, :])
+        angles = angles.reshape(*coords.shape[:2], -1)
+        angles = jnp.concatenate([angles, angles], axis=-1)
+        dtype = canonical_dtype(self.pos_embed_rope_dtype)
+        return jnp.sin(angles).astype(dtype), jnp.cos(angles).astype(dtype)
+
+    @nn.compact
     def get_intermediate_layers(
         self,
         x: jnp.ndarray,

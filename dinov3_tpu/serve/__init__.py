@@ -1,0 +1,36 @@
+"""Embedding-serving engine: ragged traffic at trainer efficiency.
+
+The frozen-teacher inference frontend (ROADMAP item "millions-of-users
+workload"): a continuous batcher packs variable-resolution images into
+fixed token-budget rows (batcher.py), ONE ahead-of-time-compiled
+segment-masked forward serves every pack (engine.py +
+models/vision_transformer.py packed_feature_forward), outputs land in a
+donated device ring, and bf16 weights load from any training
+checkpoint arm (weights.py). The naive per-shape-jit oracle stays
+behind ``serve.continuous_packing=false``.
+"""
+
+from dinov3_tpu.serve.batcher import (
+    ContinuousBatcher,
+    PackPlan,
+    ServeLayout,
+    patch_coords_np,
+    patchify,
+)
+from dinov3_tpu.serve.engine import (
+    OracleServeEngine,
+    PackedServeEngine,
+    ServeRing,
+    build_serve_engine,
+    serve_layout_from_cfg,
+)
+from dinov3_tpu.serve.types import ServeRequest, ServeResponse
+from dinov3_tpu.serve.weights import cast_serving_tree, load_serving_model
+
+__all__ = [
+    "ContinuousBatcher", "OracleServeEngine", "PackPlan",
+    "PackedServeEngine", "ServeLayout", "ServeRequest", "ServeResponse",
+    "ServeRing", "build_serve_engine", "cast_serving_tree",
+    "load_serving_model", "patch_coords_np", "patchify",
+    "serve_layout_from_cfg",
+]

@@ -1,0 +1,49 @@
+"""Request/response types for the embedding-serving engine.
+
+Plain dataclasses over host numpy — the serve frontend is host code
+(batcher.py packs, engine.py dispatches); nothing here touches jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One image awaiting feature extraction.
+
+    ``image``: [H, W, C] float32, H and W multiples of the model patch
+    size (the loader owns resize/normalize — the engine serves exactly
+    what the trainer's eval path would forward). ``arrival_s`` is the
+    submit timestamp on whatever clock the caller replays (bench_serve
+    uses a virtual clock so latency percentiles don't require real
+    sleeps)."""
+
+    request_id: int
+    image: np.ndarray
+    arrival_s: float = 0.0
+
+    @property
+    def hw(self) -> tuple[int, int]:
+        return int(self.image.shape[0]), int(self.image.shape[1])
+
+
+@dataclasses.dataclass
+class ServeResponse:
+    """Features for one request: the CLS embedding and the mean-pooled
+    patch embedding (both [D] float32 — the two feature views the eval
+    harness and downstream retrieval consume)."""
+
+    request_id: int
+    cls_feature: np.ndarray
+    pooled_patch_feature: np.ndarray
+    n_patches: int
+    arrival_s: float = 0.0
+    done_s: float = 0.0
+
+    @property
+    def latency_s(self) -> float:
+        return self.done_s - self.arrival_s

@@ -1,0 +1,65 @@
+"""Checkpoint -> replicated bf16 serving tree, from ANY training arm.
+
+The training checkpoints differ across opt-state arms only in the adam
+moments' layout (replicated model-shaped / PR-5 flat padded / PR-9
+bucket dicts / PR-7 zero3-sharded model-shaped — checkpoint.py
+_adapt_opt_leaf); the ``params`` tree stays MODEL-shaped in every arm,
+so the frozen teacher backbone restores identically from all four. This
+module is the serving entry on top of that invariant: partial-restore
+``params.teacher.backbone`` (the build_model_for_eval pattern,
+models/__init__.py), cast the float leaves to bf16 once, and hand the
+engine one replicated serving tree. The cast is a pure elementwise
+round-to-nearest-even — deterministic, so the same checkpoint always
+yields the same serving tree bitwise (pinned, with the four-arm
+equality, in tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_serving_tree(params, dtype=jnp.bfloat16):
+    """Cast every floating leaf to the serving dtype (ints — e.g. MoE
+    counters — pass through). Idempotent and deterministic."""
+
+    def cast(leaf):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree.map(cast, params)
+
+
+def serving_config(cfg):
+    """A serving copy of the training config: pipeline parallelism off
+    (the segment-masked block stack has no pipeline path —
+    models/vision_transformer.py _run_blocks raises on seg + pipe) and
+    drop-path inert (the serving forward is deterministic anyway)."""
+    scfg = copy.deepcopy(cfg)
+    scfg.parallel.pipe = 1
+    return scfg
+
+
+def load_serving_model(cfg, ckpt_dir: str | None = None, params=None,
+                       dtype=jnp.bfloat16):
+    """(model, bf16 params) for the serve engine.
+
+    ``ckpt_dir``: a training checkpoint directory from any opt-state
+    arm — the EMA teacher backbone is partial-restored from it.
+    ``params``: an already-restored f32/bf16 backbone tree (tests, or a
+    caller that did its own restore) — used as-is, cast only.
+    Passing neither serves the random init (smoke benches).
+    """
+    from dinov3_tpu.models import build_backbone, build_model_for_eval
+
+    scfg = serving_config(cfg)
+    if params is not None:
+        model = build_backbone(scfg, teacher=True)
+    else:
+        model, params = build_model_for_eval(scfg, ckpt_dir)
+    return model, cast_serving_tree(params, dtype)

@@ -306,6 +306,14 @@ def classify_copy(line: str) -> str:
       the overlap twin in models/streaming.py) — the leaf→bucket
       assembly traffic coalescing introduces, named for the same reason
       as "update_shard".
+    - "serve": copies inside the serve engine's plane assembly,
+      per-segment extraction, and donated output ring (the
+      ``serve_pack``/``serve_extract``/``serve_ring`` named scopes in
+      models/vision_transformer.py packed_feature_forward and
+      serve/engine.py make_serve_step) — the token/feature-plane
+      traffic continuous packing introduces, attributed so the serve
+      step's census ceiling names it (scripts/bench_serve.py pins zero
+      unattributed).
     - "rng": u32 results of <= 8 elements — threefry key/counter
       plumbing (keys are u32[2]/u32[4]; fold_in intermediates scalar).
     - "small": any other result of <= 1024 elements (scalar metrics,
@@ -328,6 +336,9 @@ def classify_copy(line: str) -> str:
             or "bucket_gather" in line or "bucket_prefetch" in line
             or "bucket_stream" in line):
         return "bucket"
+    if ("serve_pack" in line or "serve_extract" in line
+            or "serve_ring" in line):
+        return "serve"
     shp = _hlo_result_shape(line)
     if shp is None:
         return "small"

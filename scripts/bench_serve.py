@@ -1,0 +1,396 @@
+"""Serving A/B for the continuous-packing engine (serve/): the
+committed evidence behind SERVE_r14.json.
+
+Methodology (the PR-1..5 discipline — measure the exact shipped code
+paths, stated precisely because this is the committed evidence in
+docs/PERFORMANCE.md):
+
+- **Three traffic mixes**, each a seeded draw of [H, W, 3] requests:
+  ``uniform_224`` (every request the same square resolution — the mix
+  rectangular batching is built for, kept as the oracle's home turf),
+  ``mixed_ragged`` (H and W drawn INDEPENDENTLY on the 16px grid
+  across banded 96..512px resolutions, small-skewed the way embedding
+  traffic is — the shape space is hundreds of (H, W) pairs, so
+  shape-polymorphic serving can never stay warm), and ``heavy_tail``
+  (90% small 96..160px crops, 10% near-max 448..512px).
+- **Three arms over identical traffic**: the packed engine
+  (serve.continuous_packing, ONE ahead-of-time compile at build) and
+  the two naive oracles (``oracle_rectangular``: group by exact shape,
+  pad each group's batch to the next power of two; ``oracle_per_image``:
+  one dispatch per request). All arms serve the SAME bf16 weight tree
+  through the same admission/flush-deadline batcher policy.
+- **Warmup protocol**: each arm first serves a DISJOINT warmup draw
+  from the same mix distribution. That fully warms the packed arm (its
+  one program is shape-independent) and warms the oracles exactly as
+  much as a real deployment could (they cannot pre-trace traffic
+  shapes they have not seen; the per-arm record reports how many
+  measured shapes were novel after warmup). Oracle recompiles during
+  measurement are part of the measured serving cost — that is the
+  pathology under test — and are reported separately as
+  ``compile_growth_during_measurement``.
+- **Throughput (sustained drain)**: all measured requests arrive at
+  t=0; img/s = N / wall-seconds of the drain. The stream is long
+  enough (several full token budgets) that the packed arm's last
+  partial pack amortizes.
+- **Latency (virtual-clock rated replay)**: Poisson arrivals at 0.7x
+  the PACKED arm's measured sustained rate — the same trace for every
+  arm, so an arm slower than the offered rate visibly queues. The
+  clock advances by each flush's measured wall time (plus waits to the
+  next arrival/deadline), so percentiles don't require real sleeps;
+  p50/p99 are over per-request ``done_s - arrival_s``.
+- **Accounting**: per (arm, mix) record embeds bench.py's
+  ``_serve_summary`` (token budget, measured pad waste, the
+  blocking_fetch funnel counters) and re-fires the
+  ``warn_serve_pad_waste`` guardrail against the MEASURED mix waste;
+  the packed arm's one program carries the full copy + collective
+  census (utils.hlo_copy_census / hlo_collective_census) with the
+  serve-scoped traffic attributed and zero unattributed collectives
+  pinned (tests/test_serve.py reads these from the committed record).
+
+Layout for the full run: rows=4 x row_tokens=1025 (one max-envelope
+image per row; dense segment-masked attention is O(row_tokens^2) per
+row, so the smallest row that fits the 512px request minimizes the
+fixed pack cost) and max_segments_per_row=28 (a row of 96px requests
+holds 27 — anything lower slot-caps small traffic into pure padding).
+
+Writes one JSON document (default ./SERVE_r14.json) and prints it.
+
+Usage: JAX_PLATFORMS=cpu python scripts/bench_serve.py \
+           [--smoke] [--out SERVE_r14.json] [--seed 0] [--n N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+# ---------------- traffic mixes ----------------
+#
+# Each mix is banded: (probability, (min_px, max_px)); H and W are
+# drawn independently on the patch-size grid inside the band (square
+# only when the band is a single value). Small-skewed bands reflect
+# embedding-serving reality (thumbnails and crops dominate; full-res
+# is the tail) — and raggedness is the point: the (H, W) space of the
+# mixed bands is ~300 shapes, so per-shape jit caches never converge.
+
+MIXES_FULL = {
+    "uniform_224": [(1.0, (224, 224))],
+    "mixed_ragged": [(0.70, (96, 256)), (0.20, (208, 320)),
+                     (0.10, (336, 512))],
+    "heavy_tail": [(0.90, (96, 160)), (0.10, (448, 512))],
+}
+
+MIXES_SMOKE = {
+    "uniform_224": [(1.0, (16, 16))],
+    "mixed_ragged": [(0.70, (8, 16)), (0.20, (20, 24)), (0.10, (28, 32))],
+    "heavy_tail": [(0.90, (8, 12)), (0.10, (28, 32))],
+}
+
+
+def make_mix(rng: np.random.Generator, bands, n: int, grid: int) -> list:
+    """n seeded [H, W, 3] float32 images from the banded distribution."""
+    probs = np.array([p for p, _ in bands])
+    out = []
+    for b in rng.choice(len(bands), size=n, p=probs / probs.sum()):
+        lo, hi = bands[int(b)][1]
+        sizes = np.arange(lo, hi + 1, grid)
+        h, w = rng.choice(sizes), rng.choice(sizes)
+        out.append(rng.standard_normal((int(h), int(w), 3))
+                   .astype(np.float32))
+    return out
+
+
+# ---------------- replays ----------------
+
+
+def drain_all(engine, images) -> tuple[float, list]:
+    """All arrivals at t=0; wall-seconds and responses of the drain."""
+    for i, im in enumerate(images):
+        engine.submit(im, request_id=i, arrival_s=0.0)
+    t0 = time.perf_counter()
+    responses = []
+    while engine.queue_len:
+        responses.extend(engine.flush())
+    wall = time.perf_counter() - t0
+    assert len(responses) == len(images)
+    return wall, responses
+
+
+def rated_replay(engine, trace) -> dict:
+    """Virtual-clock discrete-event replay of a timed arrival trace.
+
+    ``trace``: [(arrival_s, image)] sorted by arrival. The clock
+    advances by (a) jumps to the next arrival / flush deadline while
+    idle and (b) each flush's MEASURED wall time while serving — so a
+    too-slow arm accumulates queueing delay exactly as a real frontend
+    would, without wall-clock sleeps between arrivals.
+    """
+    now, i = 0.0, 0
+    responses = []
+    while i < len(trace) or engine.queue_len:
+        while i < len(trace) and trace[i][0] <= now:
+            engine.submit(trace[i][1], request_id=i, arrival_s=trace[i][0])
+            i += 1
+        if engine.should_flush(now) or (i >= len(trace) and engine.queue_len):
+            t0 = time.perf_counter()
+            out = engine.flush()
+            now += time.perf_counter() - t0
+            for r in out:
+                r.done_s = now
+            responses.extend(out)
+            continue
+        nxt = []
+        if i < len(trace):
+            nxt.append(trace[i][0])
+        deadline = engine.flush_deadline()
+        if deadline is not None:
+            nxt.append(deadline)
+        if not nxt:
+            break
+        # always advance: should_flush reuses flush_deadline's exact
+        # arithmetic (serve/batcher.py) so jumping TO the deadline
+        # fires it, but a stalled clock here would spin forever
+        target = max(now, min(nxt))
+        now = target if target > now else now + 1e-6
+    lats = sorted(r.latency_s for r in responses)
+    return {
+        "n": len(responses),
+        "p50_ms": round(1e3 * lats[len(lats) // 2], 3),
+        "p99_ms": round(1e3 * lats[min(len(lats) - 1,
+                                       int(0.99 * len(lats)))], 3),
+        "mean_ms": round(1e3 * sum(lats) / len(lats), 3),
+    }
+
+
+# ---------------- per-arm measurement ----------------
+
+
+def measure_arm(engine, warm_images, meas_images, trace,
+                serve_summary, warn_fn) -> tuple[dict, list]:
+    """Disjoint warmup draw, sustained drain, rated replay, summary."""
+    from dinov3_tpu.telemetry.host_sync import host_sync_stats
+
+    drain_all(engine, warm_images)
+    compiles_after_warmup = engine.compile_count
+
+    host_sync_stats(reset=True)
+    engine.reset_pad_stats()
+    wall, responses = drain_all(engine, meas_images)
+    lat = rated_replay(engine, trace)
+    warm_shapes = {im.shape for im in warm_images}
+    rec = {
+        "throughput": {
+            "images_per_s": round(len(meas_images) / wall, 3),
+            "wall_s": round(wall, 4),
+        },
+        "latency": lat,
+        "compile_count_after_warmup": compiles_after_warmup,
+        "compile_growth_during_measurement": (
+            engine.compile_count - compiles_after_warmup),
+        "novel_shapes_after_warmup": len(
+            {im.shape for im in meas_images} - warm_shapes),
+        "serve": serve_summary(engine),
+        "pad_waste_warning": warn_fn(engine.mean_pad_waste or 0.0),
+    }
+    return rec, responses
+
+
+def feature_agreement(a, b) -> dict:
+    """Max |diff| between two arms' responses, matched by request id."""
+    bb = {r.request_id: r for r in b}
+    cls = max(float(np.abs(r.cls_feature - bb[r.request_id].cls_feature).max())
+              for r in a)
+    pooled = max(float(np.abs(r.pooled_patch_feature
+                              - bb[r.request_id].pooled_patch_feature).max())
+                 for r in a)
+    return {"cls_max_abs_diff": cls, "pooled_max_abs_diff": pooled}
+
+
+# ---------------- main ----------------
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="vit_test + tiny envelope (CI tier-1 step)")
+    ap.add_argument("--out", default="SERVE_r14.json")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--n", type=int, default=None,
+                    help="images per mix (default: 64 full / 12 smoke)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    import bench
+    from dinov3_tpu.configs.config import (
+        apply_dot_overrides,
+        get_default_config,
+        serve_pad_waste_floor,
+        warn_serve_pad_waste,
+    )
+    from dinov3_tpu.serve import (
+        OracleServeEngine,
+        PackedServeEngine,
+        load_serving_model,
+        serve_layout_from_cfg,
+    )
+    from dinov3_tpu.utils import hlo_collective_census, hlo_copy_census
+
+    n = args.n or (12 if args.smoke else 64)
+    cfg = get_default_config()
+    if args.smoke:
+        apply_dot_overrides(cfg, [
+            "student.arch=vit_test", "student.patch_size=4",
+            "serve.min_px=8", "serve.max_px=32", "serve.rows=4",
+            "serve.row_tokens=65", "serve.max_segments_per_row=12",
+            "train.scan_layers=true",
+        ])
+        mixes = MIXES_SMOKE
+    else:
+        apply_dot_overrides(cfg, [
+            "student.arch=vit_small", "train.scan_layers=true",
+            # one max-envelope image per row (min fixed pack cost: the
+            # dense segment-masked attention is O(row_tokens^2)/row),
+            # slots sized so a row of 96px requests (27 fit) isn't
+            # slot-capped into padding
+            "serve.rows=4", "serve.row_tokens=1025",
+            "serve.max_segments_per_row=28",
+        ])
+        mixes = MIXES_FULL
+
+    t0 = time.perf_counter()
+    model, params = load_serving_model(cfg)
+    layout = serve_layout_from_cfg(cfg)
+    floor = serve_pad_waste_floor(
+        layout.row_tokens, layout.patch_size, layout.n_prefix,
+        layout.min_px, layout.max_px)
+    print(f"[bench_serve] {cfg.student.arch} rows={layout.rows} "
+          f"row_tokens={layout.row_tokens} budget={layout.token_budget} "
+          f"envelope={layout.min_px}..{layout.max_px}px "
+          f"floor(mean)={floor['mean_waste']:.3f} "
+          f"build {time.perf_counter() - t0:.1f}s", flush=True)
+
+    def build_engine(arm):
+        if arm == "packed":
+            return PackedServeEngine(model, params, layout, warn=False)
+        return OracleServeEngine(model, params, layout,
+                                 mode=arm.removeprefix("oracle_"))
+
+    record = {
+        "what": ("continuous-packing serve engine vs naive oracles: "
+                 "sustained img/s + rated p50/p99 over three traffic "
+                 "mixes, identical bf16 weights and batcher policy; "
+                 "oracle arms warm on a disjoint draw, so their "
+                 "recompiles on novel traffic shapes are measured "
+                 "serving cost"),
+        "arch": cfg.student.arch,
+        "smoke": bool(args.smoke),
+        "seed": args.seed,
+        "n_per_mix": n,
+        "backend": jax.default_backend(),
+        "layout": {
+            "rows": layout.rows, "row_tokens": layout.row_tokens,
+            "token_budget": layout.token_budget,
+            "n_prefix": layout.n_prefix,
+            "patch_size": layout.patch_size,
+            "min_px": layout.min_px, "max_px": layout.max_px,
+            "max_segments_per_row": layout.max_segments_per_row,
+        },
+        "pad_waste_floor": {k: round(v, 4) if isinstance(v, float) else v
+                            for k, v in floor.items()},
+        "mixes": {},
+    }
+
+    arms = ("packed", "oracle_rectangular", "oracle_per_image")
+    engines = {arm: build_engine(arm) for arm in arms}
+
+    # the one packed program's census, from its optimized HLO
+    hlo = engines["packed"].compiled_text()
+    copies = hlo_copy_census(hlo)
+    colls = hlo_collective_census(hlo)
+    record["packed_census"] = {
+        "compile_s": round(engines["packed"].compile_s, 3),
+        "copy_total": copies["hlo_copy_total"],
+        "copy_by_category": {k: v["ops"]
+                             for k, v in copies["by_category"].items()},
+        "collective_total": colls["hlo_collective_total"],
+        "collective_unattributed": colls["unattributed"],
+    }
+
+    for mix_name, bands in mixes.items():
+        rng = np.random.default_rng(args.seed)
+        warm_images = make_mix(rng, bands, n, layout.patch_size)
+        meas_images = make_mix(rng, bands, n, layout.patch_size)
+        tokens = sum(layout.seq_len(im.shape[0], im.shape[1])
+                     for im in meas_images)
+        mix_rec = {
+            "n": n,
+            "measured_tokens": tokens,
+            "distinct_shapes_measured": len(
+                {im.shape for im in meas_images}),
+        }
+        responses = {}
+
+        # packed first: its sustained rate sets the rated-replay
+        # arrival trace every arm then replays
+        trace = None
+        for arm in arms:
+            eng = engines[arm]
+            print(f"[bench_serve] {mix_name}/{arm} ...", flush=True)
+            if trace is None:
+                # probe the packed sustained rate on the warmup draw
+                # (its own warmup: the AOT program needs one execution
+                # for allocator/runtime steady state)
+                drain_all(eng, warm_images)
+                wall, _ = drain_all(eng, warm_images)
+                rate = 0.7 * (n / wall)
+                arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+                trace = [(float(a), im)
+                         for a, im in zip(arrivals, meas_images)]
+                mix_rec["offered_rate_images_per_s"] = round(rate, 3)
+            arm_rec, resp = measure_arm(
+                eng, warm_images, meas_images, trace,
+                lambda e: bench._serve_summary(
+                    e, copies if e.arm == "packed" else None),
+                lambda w, a=arm: warn_serve_pad_waste(
+                    w, stacklevel=3,
+                    axis=f"measured {mix_name} mix, {a} arm"),
+            )
+            mix_rec[arm] = arm_rec
+            responses[arm] = resp
+
+        for arm in ("oracle_rectangular", "oracle_per_image"):
+            mix_rec[f"features_vs_{arm}"] = feature_agreement(
+                responses["packed"], responses[arm])
+        mix_rec["speedup_vs_rectangular"] = round(
+            mix_rec["packed"]["throughput"]["images_per_s"]
+            / mix_rec["oracle_rectangular"]["throughput"]["images_per_s"], 3)
+        mix_rec["speedup_vs_per_image"] = round(
+            mix_rec["packed"]["throughput"]["images_per_s"]
+            / mix_rec["oracle_per_image"]["throughput"]["images_per_s"], 3)
+        record["mixes"][mix_name] = mix_rec
+        print(f"[bench_serve] {mix_name}: packed "
+              f"{mix_rec['packed']['throughput']['images_per_s']} img/s, "
+              f"rect x{mix_rec['speedup_vs_rectangular']}, "
+              f"per-image x{mix_rec['speedup_vs_per_image']}", flush=True)
+
+    record["packed_compile_count"] = engines["packed"].compile_count
+
+    out = json.dumps(record, indent=1)
+    with open(args.out, "w") as f:
+        f.write(out + "\n")
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

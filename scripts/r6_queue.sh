@@ -94,6 +94,21 @@
 #         unmeasured (ADVICE r5 low). Seconds-long compiles, banks the
 #         crossover table + the executable recommended_flash_min_seq
 #         the threshold cites.
+#   phE   continuous-packing serve engine A/B (the ragged-traffic
+#         inference attack, dinov3_tpu/serve/): scripts/bench_serve.py
+#         runs all three arms — packed (serve.continuous_packing
+#         auto=on, ONE AOT fixed-shape compile) vs the rectangular-
+#         batch and per-image shape-polymorphic oracles — over three
+#         traffic mixes with disjoint warmup/measurement draws, and
+#         embeds per-arm compile growth, pad waste, host-sync fetch
+#         counts and the serve-category copy census in one record.
+#         CPU-side accounting (SERVE_r14.json): packed >=2x the
+#         rectangular oracle img/s on the mixed ragged band at
+#         bf16-pinned feature equality, 1 compile after warmup; this
+#         measures what TPU compile latency and HBM bandwidth do to
+#         both sides of that ratio (oracle recompiles cost more
+#         on-chip, but the packed row's O(row^2) dense attention
+#         meets an 8x faster matmul unit).
 # Every bench.py record now embeds the fixed calibration rung
 # ("calib"), so these rows are comparable across sessions.
 #
@@ -290,6 +305,25 @@ if gate_phase 2400 phG2_attn_crossover; then
     else
         note "FAIL  phG2_attn_crossover rc=$?"
         echo "{\"tag\": \"phG2_attn_crossover\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
+    fi
+fi
+
+# phE: continuous-packing serve engine A/B. bench_serve.py runs the
+# packed arm and both oracles in ONE process (same session, shared
+# calib conditions by construction) over the three committed traffic
+# mixes; the record already embeds per-arm compile growth, pad waste
+# and the serve copy census, so the whole A/B is one JSON object.
+if gate_phase 3000 phE_serve_packing; then
+    note "start phE_serve_packing"
+    rm -f /tmp/serve_r6.json
+    if timeout 3000 python scripts/bench_serve.py \
+            --out /tmp/serve_r6.json >> "$LOG" 2>&1; then
+        note "done  phE_serve_packing -> /tmp/serve_r6.json"
+        line=$(python -c "import json,sys; print(json.dumps(json.load(open('/tmp/serve_r6.json'))))")
+        echo "{\"tag\": \"phE_serve_packing\", \"rc\": 0, \"result\": $line}" >> "$RESULTS"
+    else
+        note "FAIL  phE_serve_packing rc=$?"
+        echo "{\"tag\": \"phE_serve_packing\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
     fi
 fi
 
