@@ -432,6 +432,14 @@ def _serve_summary(engine, copy_census=None) -> dict:
         out["serve_copies"] = by_cat.get("serve", {}).get("ops", 0)
         out["unattributed_copies"] = by_cat.get(
             "unattributed", {}).get("ops", 0)
+    obs = getattr(engine, "observer", None)
+    if obs is not None:
+        # observability-plane sidecar: packs/requests/windows seen, the
+        # per-SLO streaming-histogram summaries, the live-mix EWMA pad
+        # waste and the re-derived envelope (telemetry/serve_obs.py) —
+        # finalize() also serializes the full instruments into the span
+        # stream for scripts/obs_report.py
+        out["obs"] = obs.finalize()
     return out
 
 

@@ -587,6 +587,34 @@ def continuous_packing_wished(cfg: ConfigNode) -> bool:
     return bool(cp)
 
 
+def serve_obs_wished(cfg: ConfigNode) -> bool:
+    """Whether the config ASKS for the serving observability plane
+    (telemetry/serve_obs.py ServeObserver behind the serve engines).
+    ``telemetry.serve_spans``: auto/true (default) = observe; false =
+    the blind pre-PR-11 serving path (kept as the zero-overhead
+    oracle, the repo's legacy-path convention)."""
+    t = (cfg.get("telemetry") or {}).get("serve_spans", "auto")
+    if isinstance(t, str):
+        return t.lower() in ("auto", "true", "on")
+    return bool(t)
+
+
+def serve_obs_kwargs(cfg: ConfigNode) -> dict:
+    """The ``telemetry.serve_*`` block resolved into ServeObserver
+    constructor kwargs (defaults mirror ssl_default_config.yaml)."""
+    t = cfg.get("telemetry") or {}
+    return {
+        "window_packs": int(t.get("serve_window_packs", 16) or 16),
+        "hist_lo_ms": float(t.get("serve_hist_lo_ms", 1e-2) or 1e-2),
+        "hist_hi_ms": float(t.get("serve_hist_hi_ms", 1e5) or 1e5),
+        "bins_per_decade": int(
+            t.get("serve_hist_bins_per_decade", 16) or 16),
+        "mix_alpha": float(t.get("serve_mix_alpha", 0.25) or 0.25),
+        "window_deadline_s": float(
+            t.get("serve_window_deadline_s", 0.0) or 0.0),
+    }
+
+
 def serve_pad_waste_floor(
     row_tokens: int, patch_size: int, n_prefix: int,
     min_px: int, max_px: int,

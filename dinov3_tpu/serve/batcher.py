@@ -133,6 +133,24 @@ class PackPlan:
         guardrail (configs/config.py)."""
         return 1.0 - self.tokens_used / self.layout.token_budget
 
+    @property
+    def n_segments(self) -> int:
+        return len(self.placements)
+
+    @property
+    def pad_tokens(self) -> int:
+        return self.layout.token_budget - self.tokens_used
+
+    def placement_summary(self) -> list:
+        """Host-side per-request view for the observability plane
+        (telemetry/serve_obs.py): ``(request_id, slo, seq_len)`` per
+        placement — the twin of the device-computed stats row the
+        engine fetches off the ring, so scripts/obs_report.py can
+        census host/device agreement."""
+        return [(pl.request.request_id, pl.request.slo,
+                 self.layout.n_prefix + pl.n_patches)
+                for pl in self.placements]
+
 
 class ContinuousBatcher:
     """Admit -> (budget | deadline) -> FFD row assignment -> planes.

@@ -37,17 +37,30 @@ from dinov3_tpu.serve.types import ServeRequest, ServeResponse
 
 class ServeRing(NamedTuple):
     """Donated output planes: [depth, R, S, D] f32 CLS and pooled-patch
-    features. Depth 2 = double buffering — slot t is fetched while the
-    buffers for slot t+1 are already owned by the next dispatch."""
+    features, plus a [depth, 4] per-pack stats row (token occupancy,
+    segment count, pad tokens, step stamp — SERVE_STATS_FIELDS) the
+    observability plane reads in the SAME fetch as the features, so
+    device-side serve stats cost zero extra blocking syncs. Depth 2 =
+    double buffering — slot t is fetched while the buffers for slot t+1
+    are already owned by the next dispatch."""
 
     cls: jnp.ndarray
     pooled: jnp.ndarray
+    stats: jnp.ndarray
+
+
+# field order of the ServeRing.stats row — shared with the observer
+# (telemetry/serve_obs.py) and the host/device agreement census
+# (scripts/obs_report.py)
+SERVE_STATS_FIELDS = ("tokens_used", "n_segments", "pad_tokens", "stamp")
 
 
 def make_serve_ring(depth: int, rows: int, n_slots: int, embed_dim: int):
     shape = (depth, rows, n_slots, embed_dim)
     return ServeRing(cls=jnp.zeros(shape, jnp.float32),
-                     pooled=jnp.zeros(shape, jnp.float32))
+                     pooled=jnp.zeros(shape, jnp.float32),
+                     stats=jnp.zeros((depth, len(SERVE_STATS_FIELDS)),
+                                     jnp.float32))
 
 
 def make_serve_step(model, n_slots: int):
@@ -58,10 +71,19 @@ def make_serve_step(model, n_slots: int):
     gathered from the cls-normed plane at its host-recorded position;
     the pooled patch feature is a masked mean over the patch-normed
     plane (one [R, S, N] x [R, N, D] einsum — no per-segment slicing,
-    so the program stays fixed-shape whatever the segment layout)."""
+    so the program stays fixed-shape whatever the segment layout).
+
+    The stats row (``serve_ring`` scope) is computed from the SAME seg
+    planes the forward consumed — occupancy/segment counts as the
+    device saw them, not as the host planned them — and written beside
+    the features, so the observability plane's one-fetch discipline
+    holds (ISSUE 11 tentpole (b): stats ride the existing ring fetch).
+    ``stamp`` is the host's pack counter echoed through the device, the
+    freshness check that the fetched slot belongs to the pack the host
+    thinks it does."""
 
     def step(params, ring, patches, coords, prefix_idx, seg, cls_index,
-             slot):
+             slot, stamp):
         out = model.apply({"params": params}, patches, coords, prefix_idx,
                           seg, method="packed_feature_forward")
         with jax.named_scope("serve_extract"):
@@ -75,11 +97,19 @@ def make_serve_step(model, n_slots: int):
             counts = sel.sum(-1)
             pooled = pooled / jnp.maximum(counts, 1.0)[..., None]
         with jax.named_scope("serve_ring"):
+            tokens_used = (seg >= 0).sum().astype(jnp.float32)
+            n_segments = (counts > 0).sum().astype(jnp.float32)
+            budget = jnp.float32(seg.shape[0] * seg.shape[1])
+            stats_row = jnp.stack([
+                tokens_used, n_segments, budget - tokens_used,
+                stamp.astype(jnp.float32)])
             ring = ServeRing(
                 cls=jax.lax.dynamic_update_slice(
                     ring.cls, cls[None], (slot, 0, 0, 0)),
                 pooled=jax.lax.dynamic_update_slice(
                     ring.pooled, pooled[None], (slot, 0, 0, 0)),
+                stats=jax.lax.dynamic_update_slice(
+                    ring.stats, stats_row[None], (slot, 0)),
             )
         return ring
 
@@ -138,6 +168,10 @@ class PackedServeEngine:
         self.last_pad_waste: float | None = None
         self._waste_used = 0
         self._waste_total = 0
+        # observability hook (telemetry/serve_obs.ServeObserver or
+        # None): admission + per-pack phase timings flow through it;
+        # the engine itself never blocks on its account
+        self.observer = None
 
     def _abstract_planes(self):
         L = self.layout
@@ -148,6 +182,7 @@ class PackedServeEngine:
             jnp.zeros((L.rows, L.row_tokens), jnp.int32),
             jnp.zeros((L.rows, L.row_tokens), jnp.int32),
             jnp.zeros((L.rows, L.max_segments_per_row), jnp.int32),
+            jnp.zeros((), jnp.int32),
             jnp.zeros((), jnp.int32),
         )
 
@@ -174,10 +209,16 @@ class PackedServeEngine:
 
     # ---------------- serving ----------------
 
-    def submit(self, image, request_id: int, arrival_s: float = 0.0) -> None:
-        self.batcher.admit(ServeRequest(
+    def submit(self, image, request_id: int, arrival_s: float = 0.0,
+               slo: str = "default") -> None:
+        req = ServeRequest(
             request_id=request_id, image=np.asarray(image, np.float32),
-            arrival_s=arrival_s))
+            arrival_s=arrival_s, slo=slo)
+        self.batcher.admit(req)
+        if self.observer is not None:
+            h, w = req.hw
+            self.observer.on_admit(request_id, slo,
+                                   self.layout.seq_len(h, w), h, w)
 
     @property
     def queue_len(self) -> int:
@@ -191,17 +232,22 @@ class PackedServeEngine:
 
     def flush(self) -> list[ServeResponse]:
         """Run ONE pack off the queue (callers loop while queue_len)."""
+        t0 = time.perf_counter()
         plan = self.batcher.next_pack()
         if plan is None:
             return []
-        return self.run_pack(plan)
+        placement_ms = (time.perf_counter() - t0) * 1e3
+        return self.run_pack(plan, placement_ms=placement_ms)
 
-    def run_pack(self, plan: PackPlan) -> list[ServeResponse]:
+    def run_pack(self, plan: PackPlan,
+                 placement_ms: float | None = None) -> list[ServeResponse]:
         from dinov3_tpu.telemetry.host_sync import blocking_fetch
 
         planes = plan.planes
         slot = self._slot
         self._slot = (slot + 1) % self.ring_depth
+        stamp = self.packs_run
+        t_disp0 = time.perf_counter()
         self._ring = self._compiled(
             self.params, self._ring,
             jnp.asarray(planes["patches"]),
@@ -210,13 +256,20 @@ class PackedServeEngine:
             jnp.asarray(planes["seg"]),
             jnp.asarray(planes["cls_index"]),
             jnp.asarray(slot, jnp.int32),
+            jnp.asarray(stamp, jnp.int32),
         )
+        t_disp1 = time.perf_counter()
         self.packs_run += 1
         self.last_pad_waste = plan.pad_waste
         self._waste_used += plan.tokens_used
         self._waste_total += self.layout.token_budget
-        cls, pooled = blocking_fetch(
-            (self._ring.cls[slot], self._ring.pooled[slot]))
+        # ONE blocking fetch per pack — the stats row rides it, so the
+        # observability plane adds zero device syncs (funnel-pinned in
+        # tests/test_obs.py and the OBS artifact)
+        cls, pooled, stats = blocking_fetch(
+            (self._ring.cls[slot], self._ring.pooled[slot],
+             self._ring.stats[slot]))
+        t_fetch1 = time.perf_counter()
         out = []
         for pl in plan.placements:
             out.append(ServeResponse(
@@ -225,7 +278,24 @@ class PackedServeEngine:
                 pooled_patch_feature=np.asarray(pooled[pl.row, pl.slot]),
                 n_patches=pl.n_patches,
                 arrival_s=pl.request.arrival_s,
+                slo=pl.request.slo,
             ))
+        if self.observer is not None:
+            t_done = time.perf_counter()
+            dev_ms = (t_fetch1 - t_disp1) * 1e3
+            self.observer.on_pack(
+                plan.placement_summary(),
+                {"placement": placement_ms,
+                 "dispatch": (t_disp1 - t_disp0) * 1e3,
+                 # device compute is fenced by the ring fetch: this is
+                 # the dispatch-return -> fetch-return wall (== the
+                 # host-blocked fetch here, where nothing runs between)
+                 "device": dev_ms,
+                 "fetch": dev_ms,
+                 "extract": (t_done - t_fetch1) * 1e3},
+                device_stats=dict(zip(SERVE_STATS_FIELDS,
+                                      (float(v) for v in stats))),
+                tokens_used=plan.tokens_used)
         return out
 
 
@@ -253,6 +323,7 @@ class OracleServeEngine:
         self.last_pad_waste = 0.0
         self._waste_used = 0
         self._waste_total = 0
+        self.observer = None
 
         def feats(p, x):
             out = model.apply({"params": p}, x, crop_kind="global",
@@ -266,10 +337,16 @@ class OracleServeEngine:
     def compile_count(self) -> int:
         return self._feat._cache_size()
 
-    def submit(self, image, request_id: int, arrival_s: float = 0.0) -> None:
-        self.batcher.admit(ServeRequest(
+    def submit(self, image, request_id: int, arrival_s: float = 0.0,
+               slo: str = "default") -> None:
+        req = ServeRequest(
             request_id=request_id, image=np.asarray(image, np.float32),
-            arrival_s=arrival_s))
+            arrival_s=arrival_s, slo=slo)
+        self.batcher.admit(req)
+        if self.observer is not None:
+            h, w = req.hw
+            self.observer.on_admit(request_id, slo,
+                                   self.layout.seq_len(h, w), h, w)
 
     @property
     def queue_len(self) -> int:
@@ -284,6 +361,7 @@ class OracleServeEngine:
     def flush(self) -> list[ServeResponse]:
         from dinov3_tpu.telemetry.host_sync import blocking_fetch
 
+        t_place0 = time.perf_counter()
         reqs = self.batcher.drain()
         if not reqs:
             return []
@@ -296,15 +374,22 @@ class OracleServeEngine:
             for r in reqs:
                 by_hw.setdefault(r.hw, []).append(r)
             groups = list(by_hw.values())
+        placement_ms = (time.perf_counter() - t_place0) * 1e3
         used = padded = 0
+        dispatch_ms = fetch_ms = 0.0
+        t_run0 = time.perf_counter()
         for group in groups:
             B = len(group)
             Bp = 1 << (B - 1).bit_length() if self.mode == "rectangular" else B
             x = np.zeros((Bp,) + group[0].image.shape, np.float32)
             for i, r in enumerate(group):
                 x[i] = r.image
-            cls, pooled = blocking_fetch(self._feat(self.params,
-                                                    jnp.asarray(x)))
+            t0 = time.perf_counter()
+            pending = self._feat(self.params, jnp.asarray(x))
+            t1 = time.perf_counter()
+            cls, pooled = blocking_fetch(pending)
+            dispatch_ms += (t1 - t0) * 1e3
+            fetch_ms += (time.perf_counter() - t1) * 1e3
             seq = self.layout.seq_len(*group[0].hw)
             used += B * seq
             padded += Bp * seq
@@ -313,10 +398,22 @@ class OracleServeEngine:
                     request_id=r.request_id, cls_feature=cls[i],
                     pooled_patch_feature=pooled[i],
                     n_patches=seq - self.layout.n_prefix,
-                    arrival_s=r.arrival_s))
+                    arrival_s=r.arrival_s, slo=r.slo))
         self.last_pad_waste = 1.0 - used / padded if padded else 0.0
         self._waste_used += used
         self._waste_total += padded
+        if self.observer is not None:
+            t_done = time.perf_counter()
+            self.observer.on_pack(
+                [(r.request_id, r.slo, self.layout.seq_len(*r.hw))
+                 for r in reqs],
+                {"placement": placement_ms, "dispatch": dispatch_ms,
+                 # the oracle has no packed stats plane; device time is
+                 # the whole grouped run minus response assembly
+                 "device": (t_done - t_run0) * 1e3 - dispatch_ms,
+                 "fetch": fetch_ms,
+                 "extract": None},
+                device_stats=None, tokens_used=used, token_budget=padded)
         return out
 
     @property

@@ -20,11 +20,15 @@ class ServeRequest:
     what the trainer's eval path would forward). ``arrival_s`` is the
     submit timestamp on whatever clock the caller replays (bench_serve
     uses a virtual clock so latency percentiles don't require real
-    sleeps)."""
+    sleeps). ``slo`` is the service-class label the observability plane
+    keys latency histograms on (telemetry/serve_obs.py) — free-form
+    ("interactive", "batch", ...), never interpreted by the engine
+    itself."""
 
     request_id: int
     image: np.ndarray
     arrival_s: float = 0.0
+    slo: str = "default"
 
     @property
     def hw(self) -> tuple[int, int]:
@@ -43,6 +47,7 @@ class ServeResponse:
     n_patches: int
     arrival_s: float = 0.0
     done_s: float = 0.0
+    slo: str = "default"
 
     @property
     def latency_s(self) -> float:

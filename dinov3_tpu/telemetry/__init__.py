@@ -28,12 +28,32 @@ Three coupled pieces (the observability PR, ISSUE 6):
 ``host_sync.py`` is the single device->host fetch funnel both arms
 route through, so the committed ``COST_HSYNC_r11.json`` counts blocking
 fetches and host-blocked wall time per arm from the same instrument.
+
+The serving observability plane (ISSUE 11) extends the same discipline
+to the PR-10 serve engines: ``serve_obs.py`` (per-request spans, SLO
+histograms, live-mix envelope re-derivation), ``hist.py`` (fixed-memory
+log-bucketed histograms + the shared nearest-rank quantile helper), and
+``watchdog.py`` (role-namespaced heartbeats, staleness scan, flush-
+window stall spans) — one span stream and one fetch funnel cover both
+worlds.
 """
 
+from dinov3_tpu.telemetry.hist import LogHistogram, quantile_nearest_rank
 from dinov3_tpu.telemetry.host_sync import blocking_fetch, host_sync_stats
 from dinov3_tpu.telemetry.memory import per_device_state_bytes, sample_memory
 from dinov3_tpu.telemetry.ring import RingReader, RingState, make_ring, write_row
-from dinov3_tpu.telemetry.spans import SpanTracer, StepTimer
+from dinov3_tpu.telemetry.serve_obs import (
+    LiveMixTracker,
+    ServeObserver,
+    recommended_serve_envelope,
+)
+from dinov3_tpu.telemetry.spans import SERVE_PHASES, SpanTracer, StepTimer
+from dinov3_tpu.telemetry.watchdog import (
+    Watchdog,
+    heartbeat_path,
+    read_heartbeat,
+    scan_heartbeats,
+)
 
 
 def telemetry_wished(cfg) -> bool:
@@ -48,7 +68,10 @@ def telemetry_wished(cfg) -> bool:
 
 __all__ = [
     "RingReader", "RingState", "make_ring", "write_row",
-    "SpanTracer", "StepTimer",
+    "SERVE_PHASES", "SpanTracer", "StepTimer",
+    "LogHistogram", "quantile_nearest_rank",
+    "LiveMixTracker", "ServeObserver", "recommended_serve_envelope",
+    "Watchdog", "heartbeat_path", "read_heartbeat", "scan_heartbeats",
     "blocking_fetch", "host_sync_stats",
     "per_device_state_bytes", "sample_memory",
     "telemetry_wished",

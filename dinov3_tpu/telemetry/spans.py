@@ -36,11 +36,26 @@ import time
 logger = logging.getLogger("dinov3")
 
 # the hot-loop phase names train/train.py emits — one vocabulary, shared
-# with tests (schema validation) and docs/PERFORMANCE.md
+# with tests (schema validation) and docs/OBSERVABILITY.md
 PHASES = (
     "data_wait", "h2d", "dispatch", "metrics_fetch", "metrics_flush",
     "gram_refresh", "eval", "checkpoint_save",
 )
+
+# the serve-side phase names (telemetry/serve_obs.py emits them through
+# the SAME tracer/JSONL schema, so one stream covers both worlds —
+# docs/OBSERVABILITY.md span taxonomy). Ordered as a request experiences
+# them: queue wait, FFD placement + plane fill, compiled-call dispatch,
+# device compute fenced by the ring fetch, response extraction.
+SERVE_PHASES = (
+    "serve_enqueue", "serve_pack_placement", "serve_dispatch",
+    "serve_device", "serve_fetch", "serve_extract",
+)
+
+# the current span-record schema version, stamped on EVERY record so
+# readers (scripts/obs_report.py, the elastic-resume tooling) can gate
+# on it instead of sniffing fields
+SPAN_SCHEMA_V = 1
 
 
 class SpanTracer:
@@ -51,9 +66,16 @@ class SpanTracer:
     def __init__(self, output_dir: str | None, rank: int = 0,
                  enabled: bool = True, heartbeat_every: int = 1,
                  profile_steps: tuple[int, int] | None = None,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None, role: str = "train",
+                 flush_every_emits: int = 32):
         self.enabled = bool(enabled and output_dir)
         self.heartbeat_every = max(1, int(heartbeat_every))
+        self.role = str(role)
+        # bounded auto-flush: a crash between beats loses at most
+        # flush_every_emits - 1 trailing spans (0 = only beat()/close()
+        # flush, the pre-PR-11 behavior)
+        self.flush_every_emits = max(0, int(flush_every_emits))
+        self._emits_since_flush = 0
         self._profile = profile_steps
         self._profile_dir = profile_dir
         self._profiling = False
@@ -64,14 +86,27 @@ class SpanTracer:
         tdir = os.path.join(output_dir, "telemetry")
         os.makedirs(tdir, exist_ok=True)
         suffix = "" if rank == 0 else f".rank{rank}"
-        self.spans_path = os.path.join(tdir, f"spans{suffix}.jsonl")
-        self.heartbeat_path = os.path.join(tdir, f"heartbeat{suffix}")
+        # one logical stream, role-split files: the train role keeps the
+        # pre-PR-11 paths; other roles (serve) write spans.<role>.jsonl
+        # beside them so a trainer and a serve engine sharing an output
+        # dir never interleave writes mid-line. Every record carries
+        # "role", and readers (scripts/obs_report.py) fold spans*.jsonl
+        # back into the one stream. Heartbeats are ALWAYS role-
+        # namespaced (heartbeat.<role>[.rankN]) — the un-namespaced
+        # legacy name let the two roles overwrite each other's liveness
+        # signal; telemetry/watchdog.py keeps the back-compat read path.
+        rpart = "" if self.role == "train" else f".{self.role}"
+        self.spans_path = os.path.join(tdir, f"spans{rpart}{suffix}.jsonl")
+        self.heartbeat_path = os.path.join(
+            tdir, f"heartbeat.{self.role}{suffix}")
         self._f = open(self.spans_path, "a")
 
     # ---- spans ----
 
     @contextlib.contextmanager
-    def span(self, name: str, iteration: int | None = None):
+    def span(self, name: str, iteration: int | None = None, **fields):
+        """Time a block as one span record; ``fields`` ride the record
+        (serve spans attach request/pack ids this way)."""
         if not self.enabled:
             yield
             return
@@ -85,14 +120,25 @@ class SpanTracer:
                 "iteration": None if iteration is None else int(iteration),
                 "t": round(t_wall, 6),
                 "dur_ms": round((time.perf_counter() - t0) * 1e3, 4),
+                **fields,
             })
 
     def emit(self, record: dict) -> None:
-        """Append one JSONL record (buffered; flushed by ``beat`` and
-        ``close`` so the span stream trails liveness by at most one
-        heartbeat)."""
-        if self._f is not None:
-            self._f.write(json.dumps(record) + "\n")
+        """Append one JSONL record, stamped with the schema version and
+        this tracer's role. Buffered; flushed by ``beat``/``close`` and
+        by the bounded auto-flush every ``flush_every_emits`` records,
+        so a crash that never reaches ``close`` still leaves all but the
+        last flush_every_emits - 1 spans readable."""
+        if self._f is None:
+            return
+        record.setdefault("v", SPAN_SCHEMA_V)
+        record.setdefault("role", self.role)
+        self._f.write(json.dumps(record) + "\n")
+        if self.flush_every_emits:
+            self._emits_since_flush += 1
+            if self._emits_since_flush >= self.flush_every_emits:
+                self._f.flush()
+                self._emits_since_flush = 0
 
     def wrap_iter(self, iterable, name: str = "data_wait",
                   start_iteration: int = 0):
@@ -121,6 +167,7 @@ class SpanTracer:
         if not self.enabled or iteration % self.heartbeat_every:
             return
         self._f.flush()
+        self._emits_since_flush = 0
         with open(self.heartbeat_path, "w") as hb:
             hb.write(json.dumps(
                 {"iteration": int(iteration), "t": round(time.time(), 6)}))

@@ -265,7 +265,12 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         a, b = (int(x) for x in args.profile_steps.split(","))
         prof = (a, b)
 
-    from dinov3_tpu.telemetry import SpanTracer, StepTimer, blocking_fetch
+    from dinov3_tpu.telemetry import (
+        SpanTracer,
+        StepTimer,
+        Watchdog,
+        blocking_fetch,
+    )
     from dinov3_tpu.utils import (
         LossComparator,
         LossRecorder,
@@ -303,7 +308,14 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         enabled=bool(tele_cfg.get("spans", True)),
         heartbeat_every=int(tele_cfg.get("heartbeat_every", 1)),
         profile_steps=prof, profile_dir=f"{cfg.train.output_dir}/trace",
+        role="train",
+        flush_every_emits=int(tele_cfg.get("span_autoflush_every", 32)),
     )
+    # unified watchdog (telemetry/watchdog.py): a metrics-flush window
+    # whose wall time exceeds the deadline emits a stall span into the
+    # same stream the phase spans live in (0 = disabled)
+    watchdog = Watchdog(tracer, deadline_s=float(
+        tele_cfg.get("flush_deadline_s", 0.0) or 0.0))
     memory_on = bool(tele_cfg.get("memory", True)) and tracer.enabled
     if memory_on:
         tracer.emit_memory("setup")
@@ -341,7 +353,8 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         per-step consumer (meters, recorder, comparator), then enforce
         the 3-strike non-finite abort from the device-side streak."""
         nonlocal last_loss
-        with tracer.span("metrics_flush", upto - 1):
+        with watchdog.window("metrics_flush", iteration=upto - 1), \
+                tracer.span("metrics_flush", upto - 1):
             its_arr, rows, streak = reader.flush(ring, upto)
         if not len(its_arr):
             return

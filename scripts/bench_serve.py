@@ -53,10 +53,19 @@ row, so the smallest row that fits the 512px request minimizes the
 fixed pack cost) and max_segments_per_row=28 (a row of 96px requests
 holds 27 — anything lower slot-caps small traffic into pure padding).
 
+Observability (ISSUE 11): every measured (arm, mix) window runs behind
+a ``ServeObserver`` (telemetry/serve_obs.py) writing per-request phase
+spans and per-SLO streaming latency histograms into one serve-role
+span stream (``--obs-dir``); ``scripts/obs_report.py`` folds that
+stream plus this record into the committed OBS artifact. Latency
+percentiles go through the shared nearest-rank quantile helper
+(telemetry/hist.py) — exact overall and per SLO class.
+
 Writes one JSON document (default ./SERVE_r14.json) and prints it.
 
 Usage: JAX_PLATFORMS=cpu python scripts/bench_serve.py \
-           [--smoke] [--out SERVE_r14.json] [--seed 0] [--n N]
+           [--smoke] [--out SERVE_r14.json] [--seed 0] [--n N] \
+           [--obs-dir DIR]
 """
 
 from __future__ import annotations
@@ -108,13 +117,26 @@ def make_mix(rng: np.random.Generator, bands, n: int, grid: int) -> list:
     return out
 
 
+def slo_class(image, layout) -> str:
+    """Deterministic SLO class per request: small crops (both sides at
+    or below the envelope midpoint) are ``interactive`` — the
+    thumbnail/crop traffic a frontend waits on — larger requests are
+    ``batch``. Size-derived (not random) so every arm serves the same
+    class per request and the per-class percentiles compare across
+    arms."""
+    cut = (layout.min_px + layout.max_px) / 2
+    return ("interactive"
+            if max(image.shape[0], image.shape[1]) <= cut else "batch")
+
+
 # ---------------- replays ----------------
 
 
 def drain_all(engine, images) -> tuple[float, list]:
     """All arrivals at t=0; wall-seconds and responses of the drain."""
     for i, im in enumerate(images):
-        engine.submit(im, request_id=i, arrival_s=0.0)
+        engine.submit(im, request_id=i, arrival_s=0.0,
+                      slo=slo_class(im, engine.layout))
     t0 = time.perf_counter()
     responses = []
     while engine.queue_len:
@@ -122,6 +144,22 @@ def drain_all(engine, images) -> tuple[float, list]:
     wall = time.perf_counter() - t0
     assert len(responses) == len(images)
     return wall, responses
+
+
+def _lat_summary(latencies_s: list) -> dict:
+    """Exact nearest-rank percentiles of a latency sample — the shared
+    quantile helper (telemetry/hist.py), replacing the ad-hoc indexing
+    this script used to hand-roll (p50 as ``lats[len//2]`` — the UPPER
+    median on even n — and a hand-clamped p99 index)."""
+    from dinov3_tpu.telemetry.hist import quantile_nearest_rank
+
+    lats = sorted(latencies_s)
+    return {
+        "n": len(lats),
+        "p50_ms": round(1e3 * quantile_nearest_rank(lats, 0.50), 3),
+        "p99_ms": round(1e3 * quantile_nearest_rank(lats, 0.99), 3),
+        "mean_ms": round(1e3 * sum(lats) / len(lats), 3),
+    }
 
 
 def rated_replay(engine, trace) -> dict:
@@ -135,9 +173,11 @@ def rated_replay(engine, trace) -> dict:
     """
     now, i = 0.0, 0
     responses = []
+    obs = getattr(engine, "observer", None)
     while i < len(trace) or engine.queue_len:
         while i < len(trace) and trace[i][0] <= now:
-            engine.submit(trace[i][1], request_id=i, arrival_s=trace[i][0])
+            engine.submit(trace[i][1], request_id=i, arrival_s=trace[i][0],
+                          slo=slo_class(trace[i][1], engine.layout))
             i += 1
         if engine.should_flush(now) or (i >= len(trace) and engine.queue_len):
             t0 = time.perf_counter()
@@ -145,6 +185,11 @@ def rated_replay(engine, trace) -> dict:
             now += time.perf_counter() - t0
             for r in out:
                 r.done_s = now
+                if obs is not None:
+                    # end-to-end latency on the replay's VIRTUAL clock,
+                    # so the streaming histograms estimate the same
+                    # quantity as the exact-sample percentiles below
+                    obs.observe_latency(r.slo, r.latency_s, r.request_id)
             responses.extend(out)
             continue
         nxt = []
@@ -160,22 +205,29 @@ def rated_replay(engine, trace) -> dict:
         # fires it, but a stalled clock here would spin forever
         target = max(now, min(nxt))
         now = target if target > now else now + 1e-6
-    lats = sorted(r.latency_s for r in responses)
-    return {
-        "n": len(responses),
-        "p50_ms": round(1e3 * lats[len(lats) // 2], 3),
-        "p99_ms": round(1e3 * lats[min(len(lats) - 1,
-                                       int(0.99 * len(lats)))], 3),
-        "mean_ms": round(1e3 * sum(lats) / len(lats), 3),
-    }
+    out = _lat_summary([r.latency_s for r in responses])
+    by_slo: dict = {}
+    for r in responses:
+        by_slo.setdefault(r.slo, []).append(r.latency_s)
+    # exact per-class percentiles — the reference the streaming
+    # histograms (serve.obs.slo in the same record) are judged against
+    # in scripts/obs_report.py, one bucket width apart at most
+    out["by_slo"] = {slo: _lat_summary(v)
+                     for slo, v in sorted(by_slo.items())}
+    return out
 
 
 # ---------------- per-arm measurement ----------------
 
 
 def measure_arm(engine, warm_images, meas_images, trace,
-                serve_summary, warn_fn) -> tuple[dict, list]:
-    """Disjoint warmup draw, sustained drain, rated replay, summary."""
+                serve_summary, warn_fn, observer=None) -> tuple[dict, list]:
+    """Disjoint warmup draw, sustained drain, rated replay, summary.
+
+    The observer attaches AFTER warmup, beside the host_sync reset, so
+    its pack/request counters cover exactly the measured window — that
+    alignment is what lets obs_report.py pin fetches-per-pack == 1
+    (zero blocking syncs added by the observability plane)."""
     from dinov3_tpu.telemetry.host_sync import host_sync_stats
 
     drain_all(engine, warm_images)
@@ -183,6 +235,7 @@ def measure_arm(engine, warm_images, meas_images, trace,
 
     host_sync_stats(reset=True)
     engine.reset_pad_stats()
+    engine.observer = observer
     wall, responses = drain_all(engine, meas_images)
     lat = rated_replay(engine, trace)
     warm_shapes = {im.shape for im in warm_images}
@@ -200,6 +253,7 @@ def measure_arm(engine, warm_images, meas_images, trace,
         "serve": serve_summary(engine),
         "pad_waste_warning": warn_fn(engine.mean_pad_waste or 0.0),
     }
+    engine.observer = None
     return rec, responses
 
 
@@ -225,6 +279,11 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--n", type=int, default=None,
                     help="images per mix (default: 64 full / 12 smoke)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="output dir for the serve span stream "
+                         "(telemetry/serve_obs.py; scripts/obs_report.py "
+                         "folds it into the OBS artifact). Default: a "
+                         "temp dir.")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -234,6 +293,7 @@ def main() -> int:
     from dinov3_tpu.configs.config import (
         apply_dot_overrides,
         get_default_config,
+        serve_obs_kwargs,
         serve_pad_waste_floor,
         warn_serve_pad_waste,
     )
@@ -243,6 +303,7 @@ def main() -> int:
         load_serving_model,
         serve_layout_from_cfg,
     )
+    from dinov3_tpu.telemetry import ServeObserver, SpanTracer
     from dinov3_tpu.utils import hlo_collective_census, hlo_copy_census
 
     n = args.n or (12 if args.smoke else 64)
@@ -266,6 +327,18 @@ def main() -> int:
             "serve.max_segments_per_row=28",
         ])
         mixes = MIXES_FULL
+
+    obs_dir = args.obs_dir
+    if obs_dir is None:
+        import tempfile
+
+        obs_dir = tempfile.mkdtemp(prefix="bench_serve_obs_")
+    # ONE serve-role tracer for the whole run: every (mix, arm)
+    # observer writes into the same spans.serve.jsonl stream, labelled,
+    # the way a deployment's engine pool would share one stream
+    tracer = SpanTracer(obs_dir, role="serve")
+    print(f"[bench_serve] serve span stream: {tracer.spans_path}",
+          flush=True)
 
     t0 = time.perf_counter()
     model, params = load_serving_model(cfg)
@@ -357,6 +430,10 @@ def main() -> int:
                 trace = [(float(a), im)
                          for a, im in zip(arrivals, meas_images)]
                 mix_rec["offered_rate_images_per_s"] = round(rate, 3)
+            observer = ServeObserver(tracer, layout,
+                                     slo_classes=("interactive", "batch"),
+                                     **serve_obs_kwargs(cfg))
+            observer.set_labels(arm=arm, mix=mix_name)
             arm_rec, resp = measure_arm(
                 eng, warm_images, meas_images, trace,
                 lambda e: bench._serve_summary(
@@ -364,6 +441,7 @@ def main() -> int:
                 lambda w, a=arm: warn_serve_pad_waste(
                     w, stacklevel=3,
                     axis=f"measured {mix_name} mix, {a} arm"),
+                observer=observer,
             )
             mix_rec[arm] = arm_rec
             responses[arm] = resp
@@ -384,6 +462,11 @@ def main() -> int:
               f"per-image x{mix_rec['speedup_vs_per_image']}", flush=True)
 
     record["packed_compile_count"] = engines["packed"].compile_count
+    tracer.close()
+    from dinov3_tpu.telemetry.spans import SPAN_SCHEMA_V
+
+    record["obs"] = {"spans_path": os.path.abspath(tracer.spans_path),
+                     "schema_v": SPAN_SCHEMA_V}
 
     out = json.dumps(record, indent=1)
     with open(args.out, "w") as f:

@@ -1,0 +1,271 @@
+"""Fold a serve span stream + bench_serve record into the committed
+OBS artifact (OBS_r15.json) — the observability plane's evidence.
+
+Three claims, each checked here (violations raise, so the CI smoke
+step fails loudly rather than committing a hollow artifact):
+
+1. **Per-request phase breakdown**: every measured request has one
+   ``serve_request`` record in the span stream carrying the six phase
+   fields (``enqueue -> pack_placement -> dispatch -> device -> fetch
+   -> extract``, telemetry/spans.py SERVE_PHASES), and every span
+   record validates against the v1 schema (``"v": 1``, ``role``,
+   ``name``). The artifact reports the per-(arm, mix) phase
+   aggregates (mean + exact nearest-rank p50/p99 per phase).
+2. **Histogram/exact agreement**: the streaming per-SLO log-bucketed
+   histogram p50/p99 (telemetry/hist.py, carried in the record's
+   ``serve.obs.slo`` blocks) sit within ONE bucket width
+   (a ratio of 10^(1/bins_per_decade)) of the exact sorted-sample
+   nearest-rank quantiles computed by bench_serve on the same rated
+   Poisson replay (``latency.by_slo``), per (arm, mix, SLO class).
+3. **Fetch-funnel census**: on the packed arm the ``blocking_fetch``
+   count equals the observer's pack count (fetches_per_pack == 1.0) —
+   the device-side stats rows rode the EXISTING ring fetch, zero
+   blocking syncs added by the observability plane. The SERVE_r14
+   reference fetch counts ride along for cross-PR comparison.
+
+Usage: JAX_PLATFORMS=cpu python scripts/obs_report.py \
+           --serve-json SERVE.json [--spans spans.serve.jsonl] \
+           [--out OBS_r15.json] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dinov3_tpu.telemetry.hist import quantile_nearest_rank
+from dinov3_tpu.telemetry.spans import SERVE_PHASES, SPAN_SCHEMA_V
+
+# serve_request phase fields, in request order (the six SERVE_PHASES)
+_PHASE_FIELDS = tuple(f"{p.removeprefix('serve_')}_ms"
+                      for p in SERVE_PHASES)
+
+
+def load_spans(path: str) -> tuple[list, dict]:
+    """Parse + schema-validate the span stream; returns (records,
+    census). Every line must be valid JSON with ``v == 1``, a ``role``
+    and a ``name`` — the gate readers rely on instead of sniffing."""
+    records = []
+    census = {"lines": 0, "by_name": {}}
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("v") != SPAN_SCHEMA_V:
+                raise ValueError(
+                    f"{path}:{ln}: span schema v={rec.get('v')!r}, "
+                    f"expected {SPAN_SCHEMA_V}")
+            if "role" not in rec or "name" not in rec:
+                raise ValueError(f"{path}:{ln}: span record missing "
+                                 f"role/name: {sorted(rec)}")
+            census["lines"] += 1
+            census["by_name"][rec["name"]] = \
+                census["by_name"].get(rec["name"], 0) + 1
+            records.append(rec)
+    return records, census
+
+
+def phase_breakdown(requests: list) -> dict:
+    """Aggregate serve_request records into per-phase latency stats:
+    n present, mean, exact nearest-rank p50/p99 (ms)."""
+    out = {"n_requests": len(requests)}
+    for field in _PHASE_FIELDS:
+        vals = sorted(r[field] for r in requests
+                      if r.get(field) is not None)
+        if not vals:
+            out[field] = {"n": 0}
+            continue
+        out[field] = {
+            "n": len(vals),
+            "mean": round(sum(vals) / len(vals), 4),
+            "p50": round(quantile_nearest_rank(vals, 0.50), 4),
+            "p99": round(quantile_nearest_rank(vals, 0.99), 4),
+        }
+    return out
+
+
+def check_requests(requests: list, expected_n: int, where: str) -> None:
+    """Claim 1: a phase record for every measured request, each with
+    every phase FIELD present (a value may be None — the oracle arms
+    have no extract phase — but the key must exist)."""
+    if len(requests) != expected_n:
+        raise AssertionError(
+            f"{where}: {len(requests)} serve_request records for "
+            f"{expected_n} measured requests — per-request phase "
+            f"breakdown is incomplete")
+    for r in requests:
+        missing = [f for f in _PHASE_FIELDS if f not in r]
+        if missing:
+            raise AssertionError(
+                f"{where}: serve_request rid={r.get('rid')} missing "
+                f"phase fields {missing}")
+
+
+def hist_vs_exact(obs_slo: dict, exact_slo: dict, where: str) -> dict:
+    """Claim 2: per SLO class, streaming-histogram p50/p99 within one
+    log-bucket width (ratio <= width_factor) of the exact sample
+    quantiles over the same rated replay."""
+    rows = {}
+    for slo, exact in exact_slo.items():
+        h = obs_slo.get(slo)
+        if h is None or not h.get("n"):
+            raise AssertionError(
+                f"{where}/{slo}: no streaming histogram for an SLO "
+                f"class the exact sample saw")
+        width = float(h["width_factor"])
+        row = {"n_exact": exact["n"], "n_hist": h["n"],
+               "width_factor": width}
+        if h["n"] != exact["n"]:
+            raise AssertionError(
+                f"{where}/{slo}: histogram saw {h['n']} latencies, "
+                f"exact sample has {exact['n']}")
+        for q in ("p50", "p99"):
+            est, ref = float(h[q]), float(exact[f"{q}_ms"])
+            ratio = est / ref if ref else 1.0
+            row[q] = {"hist_ms": round(est, 4), "exact_ms": ref,
+                      "ratio": round(ratio, 4)}
+            if not (1.0 / width <= ratio <= width):
+                raise AssertionError(
+                    f"{where}/{slo}: histogram {q} {est:.4f}ms vs "
+                    f"exact {ref:.4f}ms — ratio {ratio:.4f} outside "
+                    f"one bucket width ({width:.4f})")
+        rows[slo] = row
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--serve-json", required=True,
+                    help="bench_serve.py output (SERVE record with the "
+                         "per-arm serve.obs blocks)")
+    ap.add_argument("--spans", default=None,
+                    help="serve span stream; default: the record's "
+                         "obs.spans_path")
+    ap.add_argument("--out", default="OBS_r15.json")
+    ap.add_argument("--reference", default=None,
+                    help="prior SERVE record (SERVE_r14.json) whose "
+                         "fetch counts ride along for comparison")
+    ap.add_argument("--smoke", action="store_true",
+                    help="label the artifact as a CI smoke run")
+    args = ap.parse_args()
+
+    with open(args.serve_json) as f:
+        serve = json.load(f)
+    spans_path = args.spans or serve.get("obs", {}).get("spans_path")
+    if not spans_path or not os.path.exists(spans_path):
+        raise FileNotFoundError(
+            f"span stream not found (--spans / record obs.spans_path): "
+            f"{spans_path!r}")
+    records, span_census = load_spans(spans_path)
+
+    out = {
+        "what": ("serving observability plane: per-request phase "
+                 "breakdown from the serve span stream, streaming-"
+                 "histogram vs exact-sample latency quantiles on the "
+                 "rated Poisson replay, and the blocking-fetch funnel "
+                 "census pinning zero observability-added device "
+                 "syncs"),
+        "smoke": bool(args.smoke or serve.get("smoke")),
+        "arch": serve.get("arch"),
+        "seed": serve.get("seed"),
+        "n_per_mix": serve.get("n_per_mix"),
+        "span_schema_v": SPAN_SCHEMA_V,
+        "span_census": span_census,
+        "mixes": {},
+    }
+
+    arms = ("packed", "oracle_rectangular", "oracle_per_image")
+    n = int(serve["n_per_mix"])
+    worst_ratio = 1.0
+    for mix_name, mix_rec in serve["mixes"].items():
+        mix_out = {}
+        for arm in arms:
+            arm_rec = mix_rec.get(arm)
+            if arm_rec is None:
+                continue
+            where = f"{mix_name}/{arm}"
+            reqs = [r for r in records
+                    if r["name"] == "serve_request"
+                    and r.get("arm") == arm and r.get("mix") == mix_name]
+            # measured window = sustained drain (n) + rated replay (n)
+            check_requests(reqs, 2 * n, where)
+            obs = arm_rec["serve"].get("obs") or {}
+            agreement = hist_vs_exact(
+                obs.get("slo", {}), arm_rec["latency"]["by_slo"], where)
+            for row in agreement.values():
+                for q in ("p50", "p99"):
+                    worst_ratio = max(worst_ratio, row[q]["ratio"],
+                                      1.0 / row[q]["ratio"])
+            arm_out = {
+                "phase_breakdown": phase_breakdown(reqs),
+                "hist_vs_exact": agreement,
+                "packs": obs.get("packs"),
+                "windows": obs.get("windows"),
+                "stalls": obs.get("stalls"),
+                "ewma_pad_waste": obs.get("ewma_pad_waste"),
+                "recommended_envelope": obs.get("recommended_envelope"),
+            }
+            if arm == "packed":
+                # claim 3: fetches == packs on the measured window
+                fetches = arm_rec["serve"]["host_sync"]["fetches"]
+                packs = obs.get("packs")
+                fpp = fetches / packs if packs else None
+                arm_out["fetch_funnel"] = {
+                    "fetches": fetches, "packs": packs,
+                    "fetches_per_pack": fpp,
+                    "blocked_ms": arm_rec["serve"]["host_sync"].get(
+                        "blocked_ms"),
+                }
+                if fpp != 1.0:
+                    raise AssertionError(
+                        f"{where}: {fetches} blocking fetches over "
+                        f"{packs} packs — the stats plane must ride "
+                        f"the existing ring fetch, not add syncs")
+                # device stats rows rode that one fetch: census their
+                # agreement with the host-side plan
+                stats = [r for r in records
+                         if r["name"] == "serve_pack_stats"
+                         and r.get("arm") == arm
+                         and r.get("mix") == mix_name]
+                mismatch = sum(
+                    1 for r in stats
+                    if r.get("host_tokens_used") is not None
+                    and int(r["tokens_used"]) != int(r["host_tokens_used"]))
+                arm_out["device_stats"] = {
+                    "rows": len(stats),
+                    "host_token_mismatches": mismatch,
+                }
+                if stats and mismatch:
+                    raise AssertionError(
+                        f"{where}: {mismatch}/{len(stats)} device stats "
+                        f"rows disagree with the host-side token plan")
+            mix_out[arm] = arm_out
+        out["mixes"][mix_name] = mix_out
+
+    out["worst_hist_exact_ratio"] = round(worst_ratio, 4)
+    if args.reference and os.path.exists(args.reference):
+        with open(args.reference) as f:
+            ref = json.load(f)
+        out["reference_fetch_counts"] = {
+            mix: {"fetches": rec["packed"]["serve"]["host_sync"]["fetches"],
+                  "blocked_ms": rec["packed"]["serve"]["host_sync"].get(
+                      "blocked_ms")}
+            for mix, rec in ref.get("mixes", {}).items()
+            if "packed" in rec}
+        out["reference"] = os.path.basename(args.reference)
+
+    doc = json.dumps(out, indent=1)
+    with open(args.out, "w") as f:
+        f.write(doc + "\n")
+    print(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

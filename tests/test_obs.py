@@ -1,0 +1,603 @@
+"""Serving observability plane (telemetry/serve_obs.py, hist.py,
+watchdog.py + serve engine threading) and the committed OBS_r15.json.
+
+Pins:
+
+- streaming histogram: nearest-rank quantile within ONE log-bucket
+  width of the exact sorted-sample quantile on adversarial
+  distributions (bimodal, heavy-tail, constant), merge associativity,
+  fixed memory under 1e6 observations, dict round-trip;
+- the shared exact-quantile helper (the bench_serve percentile fix:
+  p50 was the upper median on even n, p99 hand-clamped);
+- SpanTracer crash-safety: bounded auto-flush leaves all but the last
+  N-1 spans readable without close(), schema version + role on every
+  record, role-split span files;
+- heartbeat namespacing (heartbeat.train / heartbeat.serve) with the
+  legacy un-namespaced read fallback, the staleness scan, and the
+  watchdog's flush-window stall spans;
+- the live-mix envelope round-trip (ISSUE 11 acceptance): the
+  SERVE_r14 measured mixes re-derive an envelope that keeps
+  warn_serve_pad_waste SILENT on the same mix and FIRES it on a
+  shifted (all-384px) mix;
+- ServeObserver end-to-end on the real packed engine: per-request
+  phase records for every request, device-side stats rows agreeing
+  with the host plan, and the blocking_fetch funnel UNCHANGED
+  (fetches == packs — stats ride the existing ring fetch);
+- the committed OBS_r15.json: phase breakdown for every measured
+  request, hist-vs-exact within one bucket width per (arm, mix, SLO
+  class), fetches_per_pack == 1.0.
+"""
+
+import importlib.util
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from dinov3_tpu.telemetry.hist import LogHistogram, quantile_nearest_rank
+from dinov3_tpu.telemetry.serve_obs import (
+    LiveMixTracker,
+    ServeObserver,
+    recommended_serve_envelope,
+    simulated_ffd_waste,
+)
+from dinov3_tpu.telemetry.spans import SERVE_PHASES, SPAN_SCHEMA_V, SpanTracer
+from dinov3_tpu.telemetry.watchdog import (
+    Watchdog,
+    heartbeat_path,
+    legacy_heartbeat_path,
+    read_heartbeat,
+    scan_heartbeats,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------- exact quantile helper ----------------
+
+
+def test_quantile_nearest_rank_semantics():
+    # ceil(q*n)-th order statistic: on even n the p50 is the LOWER
+    # median (ceil(0.5*4) = 2nd of 4) — the old bench_serve indexing
+    # lats[len//2] returned the 3rd
+    assert quantile_nearest_rank([1, 2, 3, 4], 0.5) == 2
+    assert quantile_nearest_rank([1, 2, 3], 0.5) == 2
+    assert quantile_nearest_rank([5], 0.99) == 5
+    assert quantile_nearest_rank([1, 2], 0.0) == 1   # min
+    assert quantile_nearest_rank([1, 2], 1.0) == 2   # max
+    with pytest.raises(ValueError):
+        quantile_nearest_rank([], 0.5)
+    with pytest.raises(ValueError):
+        quantile_nearest_rank([1], 1.5)
+
+
+def test_quantile_nearest_rank_matches_numpy_inverted_cdf():
+    rng = np.random.default_rng(7)
+    xs = np.sort(rng.lognormal(1.0, 2.0, 997))
+    for q in (0.01, 0.25, 0.5, 0.9, 0.99):
+        assert quantile_nearest_rank(xs, q) == np.quantile(
+            xs, q, method="inverted_cdf")
+
+
+def test_bench_serve_lat_summary_uses_shared_helper():
+    bs = _load_script("bench_serve")
+    lats = [0.004, 0.001, 0.002, 0.003]          # even n: lower median
+    s = bs._lat_summary(lats)
+    assert s["p50_ms"] == 2.0 and s["p99_ms"] == 4.0 and s["n"] == 4
+    # pinned against the exact sorted-sample quantiles on a big draw
+    rng = np.random.default_rng(0)
+    sample = list(rng.exponential(0.05, 1001))
+    s = bs._lat_summary(sample)
+    ex = sorted(sample)
+    assert s["p50_ms"] == round(1e3 * quantile_nearest_rank(ex, 0.5), 3)
+    assert s["p99_ms"] == round(1e3 * quantile_nearest_rank(ex, 0.99), 3)
+
+
+# ---------------- streaming histogram ----------------
+
+
+@pytest.mark.parametrize("name,xs", [
+    ("bimodal", np.concatenate([
+        np.random.default_rng(0).normal(2.0, 0.1, 5000),
+        np.random.default_rng(1).normal(800.0, 40.0, 5000)])),
+    ("heavy_tail", np.random.default_rng(2).pareto(1.1, 10000) + 0.5),
+    ("constant", np.full(1000, 37.5)),
+])
+def test_hist_quantile_within_one_bucket_width(name, xs):
+    xs = np.abs(xs)
+    h = LogHistogram(1e-2, 1e5, bins_per_decade=16)
+    h.observe_many(xs)
+    ex = np.sort(xs)
+    for q in (0.5, 0.9, 0.99):
+        est = h.quantile(q)
+        ref = float(quantile_nearest_rank(ex, q))
+        ratio = est / ref
+        assert 1.0 / h.width_factor <= ratio <= h.width_factor, \
+            (name, q, est, ref)
+
+
+def test_hist_merge_associative_and_pure():
+    rng = np.random.default_rng(3)
+    parts = [LogHistogram() for _ in range(3)]
+    for h in parts:
+        h.observe_many(rng.lognormal(1, 1, 500))
+    a, b, c = parts
+    ab_c = a.merge(b).merge(c)
+    a_bc = a.merge(b.merge(c))
+    assert np.array_equal(ab_c.counts, a_bc.counts)
+    assert ab_c.total == a_bc.total == 1500
+    assert ab_c.min == a_bc.min and ab_c.max == a_bc.max
+    # pure: operands untouched
+    assert a.total == 500
+    with pytest.raises(ValueError, match="incompatible"):
+        a.merge(LogHistogram(1e-1, 1e4, bins_per_decade=8))
+
+
+def test_hist_fixed_memory_under_1e6_observations():
+    h = LogHistogram()
+    nbytes0 = h.counts.nbytes
+    rng = np.random.default_rng(4)
+    for _ in range(10):
+        h.observe_many(rng.lognormal(2, 3, 100_000))
+    assert h.total == 1_000_000
+    assert h.counts.nbytes == nbytes0          # the one fixed array
+    assert int(h.counts.sum()) == h.total
+    assert h.quantile(0.99) > h.quantile(0.5) > 0
+
+
+def test_hist_out_of_range_and_round_trip():
+    h = LogHistogram(1.0, 1e3, bins_per_decade=4)
+    h.observe_many([0.0, -5.0, 0.5, 2.0, 5e4])
+    # underflow/overflow quantiles report the tracked exact extremes
+    assert h.quantile(0.01) == -5.0
+    assert h.quantile(0.999) == 5e4
+    h2 = LogHistogram.from_dict(json.loads(json.dumps(h.to_dict())))
+    assert np.array_equal(h2.counts, h.counts)
+    assert (h2.total, h2.sum, h2.min, h2.max) == \
+        (h.total, h.sum, h.min, h.max)
+    assert h2.quantile(0.5) == h.quantile(0.5)
+
+
+# ---------------- SpanTracer crash-safety + roles ----------------
+
+
+def test_span_autoflush_leaves_tail_readable(tmp_path):
+    tracer = SpanTracer(str(tmp_path), flush_every_emits=5)
+    for i in range(12):
+        tracer.emit({"name": "x", "i": i})
+    # ABANDONED: no beat(), no close(). Two auto-flushes at 5 and 10
+    # emits — at most flush_every_emits - 1 spans may be lost.
+    lines = [json.loads(ln) for ln in
+             open(tracer.spans_path).read().splitlines()]
+    assert len(lines) >= 10
+    for rec in lines:
+        assert rec["v"] == SPAN_SCHEMA_V
+        assert rec["role"] == "train"
+    assert [r["i"] for r in lines] == list(range(len(lines)))
+    tracer.close()
+
+
+def test_span_role_splits_files_and_stamps_records(tmp_path):
+    train = SpanTracer(str(tmp_path), role="train")
+    serve = SpanTracer(str(tmp_path), role="serve")
+    assert train.spans_path.endswith("spans.jsonl")
+    assert serve.spans_path.endswith("spans.serve.jsonl")
+    assert train.heartbeat_path.endswith("heartbeat.train")
+    assert serve.heartbeat_path.endswith("heartbeat.serve")
+    with serve.span("serve_dispatch", pack=3):
+        pass
+    serve.close()
+    train.close()
+    [rec] = [json.loads(ln) for ln in
+             open(serve.spans_path).read().splitlines()]
+    assert rec["role"] == "serve" and rec["pack"] == 3
+    assert rec["name"] in SERVE_PHASES
+
+
+# ---------------- watchdog: heartbeats + stall windows ----------------
+
+
+def test_heartbeat_namespacing_and_legacy_fallback(tmp_path):
+    out = str(tmp_path)
+    tracer = SpanTracer(out, role="serve", heartbeat_every=1)
+    tracer.beat(7)
+    hb = read_heartbeat(out, role="serve")
+    assert hb and not hb["legacy"] and hb["iteration"] == 7
+    assert hb["path"] == heartbeat_path(out, "serve")
+    # no train heartbeat yet: namespaced miss, no legacy either
+    assert read_heartbeat(out, role="train") is None
+    # a pre-PR-11 run left the un-namespaced file: legacy fallback
+    with open(legacy_heartbeat_path(out), "w") as f:
+        f.write(json.dumps({"iteration": 3, "t": 1.0}))
+    hb = read_heartbeat(out, role="train")
+    assert hb and hb["legacy"] and hb["iteration"] == 3
+    tracer.close()
+
+
+def test_scan_heartbeats_roles_ranks_and_staleness(tmp_path):
+    out = str(tmp_path)
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir()
+    for name, it in (("heartbeat", 1), ("heartbeat.rank3", 2),
+                     ("heartbeat.train", 5), ("heartbeat.serve", 9)):
+        (tdir / name).write_text(json.dumps({"iteration": it, "t": 0.0}))
+    rows = scan_heartbeats(out, stale_after_s=1e6)
+    by = {(r["role"], r["rank"]): r for r in rows}
+    # legacy "heartbeat.rank3" parses as (train, 3), NOT role "rank3"
+    assert set(by) == {("train", 0), ("train", 3), ("serve", 0)}
+    assert by[("train", 3)]["legacy"]
+    # the namespaced train beat shadows the legacy un-namespaced one
+    assert not by[("train", 0)]["legacy"]
+    assert all(not r["stalled"] for r in rows)
+    rows = scan_heartbeats(out, stale_after_s=1e-9,
+                           now=time.time() + 10.0)
+    assert all(r["stalled"] for r in rows)
+
+
+def test_watchdog_window_stall_span(tmp_path):
+    tracer = SpanTracer(str(tmp_path))
+    wd = Watchdog(tracer, deadline_s=1e-4)
+    with wd.window("metrics_flush", iteration=12):
+        time.sleep(0.005)
+    with wd.window("metrics_flush", deadline_s=60.0):
+        pass                                    # within deadline
+    with wd.window("metrics_flush", deadline_s=0.0):
+        time.sleep(0.002)                       # 0 disables
+    tracer.close()
+    assert wd.stalls == 1
+    stalls = [json.loads(ln) for ln in
+              open(tracer.spans_path).read().splitlines()
+              if json.loads(ln)["name"] == "stall"]
+    assert len(stalls) == 1
+    s = stalls[0]
+    assert s["window"] == "metrics_flush" and s["iteration"] == 12
+    assert s["dur_ms"] > s["deadline_ms"]
+
+
+# ---------------- live-mix tracking + envelope round-trip ----------------
+
+
+def _serve_r14_layout():
+    from dinov3_tpu.serve import ServeLayout
+
+    # the committed SERVE_r14.json full layout
+    return ServeLayout(rows=4, row_tokens=1025, n_prefix=1,
+                       max_segments_per_row=28, patch_size=16,
+                       min_px=96, max_px=512)
+
+
+def _drain_mix_through_batcher(images, layout):
+    """FFD-pack a mix (host only, no model) and return (tracker fed
+    the way the observer feeds it, measured drain waste). One window =
+    the whole drain, so the tracker's EWMA equals the measured waste
+    (per-pack windows would EWMA-overweight the trailing partial
+    pack)."""
+    from dinov3_tpu.serve import ContinuousBatcher, ServeRequest
+
+    tracker = LiveMixTracker(layout, alpha=0.25)
+    b = ContinuousBatcher(layout)
+    for i, im in enumerate(images):
+        b.admit(ServeRequest(request_id=i, image=im))
+        tracker.observe_request(layout.seq_len(*im.shape[:2]),
+                                im.shape[0], im.shape[1])
+    used = total = 0
+    while b.queue_len:
+        plan = b.next_pack()
+        tracker.observe_pack(plan.tokens_used, layout.token_budget)
+        used += plan.tokens_used
+        total += layout.token_budget
+    tracker.roll()
+    return tracker, 1.0 - used / total
+
+
+def test_envelope_round_trip_serve_r14_mixes():
+    """ISSUE 11 acceptance: SERVE_r14's measured mixes re-derive an
+    envelope that keeps warn_serve_pad_waste SILENT on the same mix
+    and FIRES it on a shifted mix."""
+    from dinov3_tpu.configs.config import warn_serve_pad_waste
+    from dinov3_tpu.serve import ServeLayout
+
+    bs = _load_script("bench_serve")
+    layout = _serve_r14_layout()
+    r14 = json.load(open(os.path.join(REPO, "SERVE_r14.json")))
+    rng = np.random.default_rng(int(r14["seed"]))
+    # SERVE_r14's seed and mix bands; a longer stream (several full
+    # token budgets) so the drain-tail partial pack amortizes, the
+    # bench_serve methodology
+    images = bs.make_mix(rng, bs.MIXES_FULL["mixed_ragged"], 256,
+                         layout.patch_size)
+
+    tracker, waste = _drain_mix_through_batcher(images, layout)
+    assert tracker.ewma_pad_waste == pytest.approx(waste)
+    env = tracker.recommended_serve_envelope(threshold=0.15)
+    assert env["within_threshold"], env
+    assert env["min_px"] == min(min(im.shape[:2]) for im in images)
+    assert env["max_px"] == max(max(im.shape[:2]) for im in images)
+    assert env["max_seq_len"] == max(
+        layout.seq_len(*im.shape[:2]) for im in images)
+
+    # SAME mix served under the re-derived envelope: waste within
+    # threshold -> the guardrail stays silent
+    env_layout = ServeLayout(
+        rows=env["rows"], row_tokens=env["row_tokens"], n_prefix=1,
+        max_segments_per_row=env["max_segments_per_row"], patch_size=16,
+        min_px=env["min_px"], max_px=env["max_px"])
+    tr_same, waste_same = _drain_mix_through_batcher(images, env_layout)
+    assert waste_same <= 0.15, waste_same
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert warn_serve_pad_waste(waste_same) is None
+        assert tr_same.check_drift(threshold=0.15) is None
+
+    # SHIFTED mix (traffic drifts to all-384px squares) under the SAME
+    # envelope: one 577-token image per 1025-token row wastes ~44% ->
+    # the drift check re-fires the guardrail
+    shifted = [np.zeros((384, 384, 3), np.float32) for _ in range(32)]
+    tr_shift, waste_shift = _drain_mix_through_batcher(shifted, layout)
+    assert waste_shift > 0.15
+    with pytest.warns(UserWarning, match="live mix EWMA"):
+        msg = tr_shift.check_drift(threshold=0.15)
+    assert msg is not None and "pad-waste" in msg
+    # and the re-derived envelope for the NEW traffic fixes it
+    env2 = tr_shift.recommended_serve_envelope(threshold=0.15)
+    assert env2["within_threshold"] and env2["row_tokens"] == 577
+
+
+def test_simulated_ffd_waste_properties():
+    # single resolution: matches the analytic floor exactly
+    assert simulated_ffd_waste([577] * 8, 1025, 28) == pytest.approx(
+        1.0 - 577 / 1025)
+    # a mix packs BETTER than the averaged single-resolution floors
+    lens = [601] * 4 + [101] * 24
+    mix_waste = simulated_ffd_waste(lens, 1025, 28)
+    avg_floor = 0.5 * (1 - (1025 // 601) * 601 / 1025) \
+        + 0.5 * (1 - (1025 // 101) * 101 / 1025)
+    assert mix_waste < avg_floor
+    # inadmissible length under the candidate row -> total waste
+    assert simulated_ffd_waste([2000], 1025, 28) == 1.0
+    assert simulated_ffd_waste([], 1025, 28) == 0.0
+
+
+def test_recommended_envelope_empty_and_ewma_weighting():
+    layout = _serve_r14_layout()
+    assert recommended_serve_envelope({}, layout) is None
+    tr = LiveMixTracker(layout, alpha=0.5)
+    assert tr.roll() is None                   # empty window
+    tr.observe_request(577, 384, 384)
+    tr.observe_pack(577, 1025)
+    tr.roll()
+    w0 = tr.ewma_pad_waste
+    assert w0 == pytest.approx(1 - 577 / 1025)
+    tr.observe_request(101, 96, 160)
+    tr.observe_pack(1010, 1025)
+    tr.roll()
+    # alpha=0.5: halfway between the window wastes
+    assert tr.ewma_pad_waste == pytest.approx(
+        0.5 * w0 + 0.5 * (1 - 1010 / 1025))
+    assert set(tr.ewma_lens) == {577, 101}
+    assert sum(tr.ewma_lens.values()) == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="alpha"):
+        LiveMixTracker(layout, alpha=0.0)
+
+
+# ---------------- ServeObserver unit flow ----------------
+
+
+def test_serve_observer_records_and_windows(tmp_path):
+    from dinov3_tpu.serve import ServeLayout
+
+    layout = ServeLayout(rows=2, row_tokens=20, n_prefix=1,
+                         max_segments_per_row=3, patch_size=4)
+    tracer = SpanTracer(str(tmp_path), role="serve")
+    obs = ServeObserver(tracer, layout, slo_classes=("interactive",),
+                        window_packs=2, warn=False)
+    obs.set_labels(arm="packed", mix="unit")
+    phases = {"placement": 0.5, "dispatch": 1.0, "device": 2.0,
+              "fetch": 2.0, "extract": 0.1}
+    for pack in range(4):
+        for rid in (2 * pack, 2 * pack + 1):
+            obs.on_admit(rid, "interactive", seq_len=5, h_px=8, w_px=8)
+        obs.on_pack([(2 * pack, "interactive", 5),
+                     (2 * pack + 1, "interactive", 5)],
+                    phases, device_stats={"tokens_used": 10.0,
+                                          "n_segments": 2.0,
+                                          "pad_tokens": 30.0,
+                                          "stamp": float(pack)},
+                    tokens_used=10)
+        for rid in (2 * pack, 2 * pack + 1):
+            obs.observe_latency("interactive", 0.004, rid)
+    summary = obs.finalize()
+    tracer.close()
+    assert summary["packs"] == 4 and summary["requests"] == 8
+    assert summary["windows"] >= 2
+    slo = summary["slo"]["interactive"]
+    assert slo["n"] == 8
+    # 4ms latencies: the histogram p50 within one bucket width
+    assert 4.0 / slo["width_factor"] <= slo["p50"] \
+        <= 4.0 * slo["width_factor"]
+    assert summary["ewma_pad_waste"] == pytest.approx(0.75)
+    env = summary["recommended_envelope"]
+    assert env["max_seq_len"] == 5 and env["within_threshold"]
+
+    recs = [json.loads(ln) for ln in
+            open(tracer.spans_path).read().splitlines()]
+    by_name = {}
+    for r in recs:
+        assert r["v"] == SPAN_SCHEMA_V and r["role"] == "serve"
+        assert r["arm"] == "packed" and r["mix"] == "unit"
+        by_name.setdefault(r["name"], []).append(r)
+    assert len(by_name["serve_request"]) == 8
+    for r in by_name["serve_request"]:
+        assert r["slo"] == "interactive"
+        assert r["enqueue_ms"] is not None
+        for f in ("pack_placement_ms", "dispatch_ms", "device_ms",
+                  "fetch_ms", "extract_ms"):
+            assert r[f] is not None
+    assert len(by_name["serve_pack_stats"]) == 4
+    assert [r["stamp"] for r in by_name["serve_pack_stats"]] == \
+        [0.0, 1.0, 2.0, 3.0]
+    assert len(by_name["serve_hist"]) == 1
+    h = LogHistogram.from_dict(by_name["serve_hist"][0]["hist"])
+    assert h.total == 8
+    assert by_name["serve_mix"][0]["recommended_envelope"] is not None
+    assert len(by_name["serve_window"]) == summary["windows"]
+
+
+# ---------------- obs_report helpers ----------------
+
+
+def test_obs_report_schema_gate_and_hist_bound(tmp_path):
+    obs_report = _load_script("obs_report")
+    good = tmp_path / "spans.jsonl"
+    good.write_text(json.dumps(
+        {"v": 1, "role": "serve", "name": "serve_request"}) + "\n")
+    records, census = obs_report.load_spans(str(good))
+    assert census["lines"] == 1 and records[0]["name"] == "serve_request"
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"role": "serve", "name": "x"}) + "\n")
+    with pytest.raises(ValueError, match="schema"):
+        obs_report.load_spans(str(bad))
+
+    ok = {"interactive": {"n": 4, "width_factor": 1.1548,
+                          "p50": 4.1, "p99": 8.2}}
+    exact = {"interactive": {"n": 4, "p50_ms": 4.0, "p99_ms": 8.0}}
+    rows = obs_report.hist_vs_exact(ok, exact, "t")
+    assert rows["interactive"]["p50"]["ratio"] == pytest.approx(
+        4.1 / 4.0, abs=1e-4)
+    drifted = {"interactive": {**ok["interactive"], "p50": 6.0}}
+    with pytest.raises(AssertionError, match="bucket width"):
+        obs_report.hist_vs_exact(drifted, exact, "t")
+    with pytest.raises(AssertionError, match="no streaming histogram"):
+        obs_report.hist_vs_exact({}, exact, "t")
+
+
+# ---------------- real engine: one fetch, stats ride it ----------------
+
+
+@pytest.fixture(scope="module")
+def tiny_packed_engine():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+    from dinov3_tpu.models import build_backbone
+    from dinov3_tpu.serve import (
+        PackedServeEngine,
+        cast_serving_tree,
+        serve_layout_from_cfg,
+    )
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, [
+        "student.arch=vit_test", "student.patch_size=4",
+        "crops.global_crops_size=16", "crops.local_crops_size=8",
+        "crops.local_crops_number=2", "train.batch_size_per_device=2",
+        "optim.scaling_rule=none", "train.scan_layers=true",
+        "serve.min_px=8", "serve.max_px=24", "serve.rows=3",
+        "serve.row_tokens=40", "serve.max_segments_per_row=6",
+    ])
+    model = build_backbone(cfg, teacher=True)
+    params = nn.meta.unbox(
+        jax.jit(model.init)(jax.random.key(0), jnp.zeros((1, 16, 16, 3)))
+    )["params"]
+    params = cast_serving_tree(params)
+    layout = serve_layout_from_cfg(cfg)
+    return PackedServeEngine(model, params, layout, warn=False)
+
+
+def test_packed_engine_stats_ride_the_one_fetch(tmp_path,
+                                                tiny_packed_engine):
+    from dinov3_tpu.telemetry.host_sync import host_sync_stats
+
+    eng = tiny_packed_engine
+    rng = np.random.default_rng(0)
+    tracer = SpanTracer(str(tmp_path), role="serve")
+    obs = ServeObserver(tracer, eng.layout, window_packs=2, warn=False)
+    eng.observer = obs
+    host_sync_stats(reset=True)
+    sizes = [(8, 8), (8, 16), (16, 16), (8, 8), (24, 16), (8, 12)]
+    for i, (h, w) in enumerate(sizes):
+        eng.submit(rng.standard_normal((h, w, 3)).astype(np.float32),
+                   request_id=i, slo="batch" if h >= 16 else "interactive")
+    responses = []
+    while eng.queue_len:
+        responses.extend(eng.flush())
+    stats = host_sync_stats(reset=True)
+    eng.observer = None
+    tracer.close()
+
+    assert len(responses) == len(sizes)
+    assert {r.slo for r in responses} == {"interactive", "batch"}
+    # THE pin: one blocking fetch per pack, observer attached — the
+    # stats plane rode the existing ring fetch, zero syncs added
+    assert stats["fetches"] == obs.packs
+
+    recs = [json.loads(ln) for ln in
+            open(tracer.spans_path).read().splitlines()]
+    reqs = [r for r in recs if r["name"] == "serve_request"]
+    assert {r["rid"] for r in reqs} == set(range(len(sizes)))
+    srows = [r for r in recs if r["name"] == "serve_pack_stats"]
+    assert len(srows) == obs.packs
+    for r in srows:
+        # device-side stats row agrees with the host-side plan: the
+        # device counted prefix+patch tokens and live segments from
+        # the same seg plane the forward consumed
+        assert int(r["tokens_used"]) == int(r["host_tokens_used"])
+        assert int(r["n_segments"]) == int(r["host_segments"])
+        assert int(r["pad_tokens"]) == \
+            eng.layout.token_budget - int(r["tokens_used"])
+    # stamps echo the engine's pack counter through the device
+    assert [int(r["stamp"]) for r in srows] == sorted(
+        int(r["stamp"]) for r in srows)
+
+
+# ---------------- the committed OBS_r15.json ----------------
+
+
+def test_obs_r15_acceptance():
+    path = os.path.join(REPO, "OBS_r15.json")
+    assert os.path.exists(path), "OBS_r15.json missing"
+    r = json.load(open(path))
+    assert r["smoke"] is False
+    assert r["span_schema_v"] == SPAN_SCHEMA_V
+    n = int(r["n_per_mix"])
+    assert set(r["mixes"]) == {"uniform_224", "mixed_ragged",
+                               "heavy_tail"}
+    width = 10 ** (1 / 16)
+    for mix, rec in r["mixes"].items():
+        for arm in ("packed", "oracle_rectangular", "oracle_per_image"):
+            a = rec[arm]
+            # per-request phase breakdown present for EVERY measured
+            # request (drain n + rated replay n)
+            assert a["phase_breakdown"]["n_requests"] == 2 * n, (mix, arm)
+            for slo, row in a["hist_vs_exact"].items():
+                for q in ("p50", "p99"):
+                    ratio = row[q]["ratio"]
+                    assert 1 / width <= ratio <= width, \
+                        (mix, arm, slo, q, ratio)
+        # zero added blocking fetches: stats rode the existing ring
+        # fetch on every pack
+        f = rec["packed"]["fetch_funnel"]
+        assert f["fetches_per_pack"] == 1.0, (mix, f)
+        assert rec["packed"]["device_stats"]["host_token_mismatches"] == 0
+        env = rec["packed"]["recommended_envelope"]
+        assert env is not None and env["row_tokens"] > 0
+    assert r["worst_hist_exact_ratio"] <= width
+    # the SERVE_r14 reference fetch counts ride along for comparison
+    assert "reference_fetch_counts" in r
+    r14 = json.load(open(os.path.join(REPO, "SERVE_r14.json")))
+    for mix, ref in r["reference_fetch_counts"].items():
+        assert ref["fetches"] == \
+            r14["mixes"][mix]["packed"]["serve"]["host_sync"]["fetches"]

@@ -327,7 +327,9 @@ def test_dryrun_span_jsonl_schema(tiny_run):
 
 def test_dryrun_heartbeat(tiny_run):
     out, _ = tiny_run
-    hb = out / "telemetry" / "heartbeat"
+    # role-namespaced since PR 11 (telemetry/watchdog.py keeps the
+    # legacy un-namespaced read path for pre-PR-11 output dirs)
+    hb = out / "telemetry" / "heartbeat.train"
     assert hb.exists()
     beat = json.loads(hb.read_text())
     assert beat["iteration"] >= 4 and beat["t"] > 0
