@@ -443,6 +443,50 @@ def _serve_summary(engine, copy_census=None) -> dict:
     return out
 
 
+def _fleet_summary(router) -> dict:
+    """The record's "fleet" block (serve/fleet.py FleetRouter): one
+    entry per pool engine — arm, weights dtype, token-budget shape,
+    SLO contract, quantized-kernel byte accounting, per-engine compile
+    count and measured pad waste — plus the admission layer's route
+    counts per (engine, SLO), the content-addressed cache counters
+    (hit rate, evictions — serve/cache.py), and the total compile
+    count the n_engines pin in SERVE_r16.json / the CI fleet smoke
+    reads. Embedded in every fleet bench record the way the
+    "serve"/"telemetry" blocks are."""
+    from dinov3_tpu.serve.quant import quant_summary
+
+    engines = {}
+    for spec in router.specs:
+        e = spec.engine
+        L = e.layout
+        mean_waste = getattr(e, "mean_pad_waste", None)
+        engines[spec.name] = {
+            "arm": e.arm,
+            "dtype": getattr(e, "weights_dtype", "bf16"),
+            "rows": L.rows,
+            "row_tokens": L.row_tokens,
+            "token_budget": L.token_budget,
+            "max_segments_per_row": L.max_segments_per_row,
+            "slo_classes": (None if spec.slo_classes is None
+                            else list(spec.slo_classes)),
+            "weights_fingerprint": spec.fingerprint,
+            "quant": quant_summary(e.params),
+            "compile_count": e.compile_count,
+            "packs_run": e.packs_run,
+            "pad_waste": (round(mean_waste, 4)
+                          if mean_waste is not None else None),
+        }
+    return {
+        "n_engines": len(router.specs),
+        "engines": engines,
+        "compile_count_total": router.compile_count,
+        "route_counts": {f"{en}/{slo}": c for (en, slo), c
+                         in sorted(router.route_counts.items())},
+        "cache": (router.cache.stats()
+                  if router.cache is not None else None),
+    }
+
+
 _CURRENT_CHILD = {"proc": None}
 
 

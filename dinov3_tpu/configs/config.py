@@ -190,6 +190,10 @@ def load_config(
     # ... and over the zero3/scan combination: sharded block weights
     # with no scan loop to stream them through
     warn_zero3_no_stream(cfg)
+    # ... and over the serve feature cache's worst-case footprint:
+    # capacity x per-entry feature bytes vs the host budget, checked at
+    # load so an oversized capacity never waits for the LRU to fill
+    warn_serve_cache_memory(cfg)
     return cfg
 
 
@@ -672,6 +676,121 @@ def warn_serve_pad_waste(
 
     warnings.warn(msg, stacklevel=stacklevel + 1)
     return msg
+
+
+def serve_quant_wished(cfg: ConfigNode) -> bool:
+    """Whether the config ASKS for int8 serving weights
+    (serve/quant.py). ``serve.quant.enabled``: OPT-IN — false (default)
+    = bf16 serving trees everywhere; true/on = fleet engines quantize
+    unless their own overlay says otherwise (``serve.fleet.engines[i]
+    .quant`` overrides per engine either way). Opt-in because int8
+    trades a measured feature drift for bytes/throughput — the
+    ``warn_quant_drift`` guardrail and the SERVE_r16 drift pin make
+    that trade visible, but the default stays exact-bf16."""
+    q = (cfg.get("serve") or {}).get("quant") or {}
+    e = q.get("enabled", False)
+    if isinstance(e, str):
+        return e.lower() in ("true", "on", "1")
+    return bool(e)
+
+
+def serve_cache_wished(cfg: ConfigNode) -> bool:
+    """Whether the fleet builds the content-addressed feature cache
+    (serve/cache.py). ``serve.cache.enabled``: auto/true (default) =
+    on — frozen weights make caching bitwise-safe, so it follows the
+    default-on-where-safe convention; false = every request computes
+    (the cache-off oracle path the PR-10 bitwise pin runs under)."""
+    c = (cfg.get("serve") or {}).get("cache") or {}
+    e = c.get("enabled", "auto")
+    if isinstance(e, str):
+        return e.lower() in ("auto", "true", "on")
+    return bool(e)
+
+
+def serve_cache_entry_bytes(embed_dim: int) -> int:
+    """Feature payload bytes of ONE cache entry: the CLS and pooled
+    [D] float32 vectors (serve/cache.py values; keys and LRU
+    bookkeeping are O(100) bytes and excluded — the budget guardrail
+    is about the feature planes)."""
+    return 2 * int(embed_dim) * 4
+
+
+def warn_quant_drift(
+    drift: float, tol: float = 0.05, stacklevel: int = 2,
+    axis: str = "int8 serving tree",
+) -> str | None:
+    """Warn when the measured int8 CLS-feature drift vs the bf16 arm
+    exceeds ``serve.quant.drift_tol`` — the same
+    pin-against-the-wider-dtype discipline bf16 serving was held to
+    against fp32 (tests/test_serve.py tolerances). Fired at engine
+    build (serve/fleet.py, with the ``quant_feature_drift`` probe) and
+    recorded per run in SERVE_r16.json. Returns the message or None."""
+    if drift <= tol:
+        return None
+    msg = (
+        f"quant drift axis [{axis}]: measured int8 CLS feature drift "
+        f"{drift:.4g} exceeds serve.quant.drift_tol={tol:.4g} — the "
+        f"quantized engine's features have left the bf16 arm's "
+        f"tolerance band. Serve this model in bf16 "
+        f"(serve.quant.enabled=false or the engine overlay's "
+        f"quant=false), or raise the tolerance only with a downstream "
+        f"quality check (docs/PERFORMANCE.md serving-fleet section)."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
+
+
+def warn_cache_memory(
+    capacity: int, embed_dim: int, budget_mb: float = 1024.0,
+    threshold: float = 1.0, stacklevel: int = 2,
+    axis: str = "serve feature cache",
+) -> str | None:
+    """Warn when the cache's worst-case feature bytes — capacity x
+    ``serve_cache_entry_bytes`` — exceed ``threshold`` x the host
+    budget (``serve.cache.host_budget_mb``). Fired at fleet build
+    (serve/fleet.py) and from ``load_config`` so an oversized capacity
+    never waits for the LRU to fill before anyone notices. Returns the
+    message or None."""
+    need_mb = int(capacity) * serve_cache_entry_bytes(embed_dim) / 2**20
+    if budget_mb <= 0 or need_mb <= threshold * budget_mb:
+        return None
+    msg = (
+        f"cache memory axis [{axis}]: serve.cache.capacity={capacity} "
+        f"x {serve_cache_entry_bytes(embed_dim)} B/entry (embed_dim "
+        f"{embed_dim}) = {need_mb:.0f} MB of feature payload at full "
+        f"occupancy, over the serve.cache.host_budget_mb={budget_mb:.0f} "
+        f"budget. Lower the capacity or raise the budget "
+        f"(serve/cache.py)."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
+
+
+def warn_serve_cache_memory(cfg: ConfigNode, stacklevel: int = 2) -> str | None:
+    """The ``load_config`` wiring of ``warn_cache_memory``: resolve the
+    configured arch's embed_dim (a flax module construction — no
+    params) and fire the capacity-vs-budget check when the cache is
+    wished. Configs that cannot build a backbone (exotic test configs)
+    are skipped — this is a serving guardrail, not a load gate."""
+    if not serve_cache_wished(cfg):
+        return None
+    c = (cfg.get("serve") or {}).get("cache") or {}
+    budget_mb = float(c.get("host_budget_mb", 1024) or 1024)
+    if budget_mb <= 0:
+        return None
+    try:
+        from dinov3_tpu.models import build_backbone
+
+        embed_dim = build_backbone(cfg, teacher=True).embed_dim
+    except Exception:
+        return None
+    return warn_cache_memory(
+        int(c.get("capacity", 4096) or 4096), embed_dim,
+        budget_mb=budget_mb, stacklevel=stacklevel + 1)
 
 
 def apply_scaling_rules_to_cfg(cfg: ConfigNode) -> ConfigNode:

@@ -8,6 +8,13 @@ models/vision_transformer.py packed_feature_forward), outputs land in a
 donated device ring, and bf16 weights load from any training
 checkpoint arm (weights.py). The naive per-shape-jit oracle stays
 behind ``serve.continuous_packing=false``.
+
+The fleet layer (ISSUE 12) stacks three composable pieces on top:
+int8 per-channel weight quantization with dequant fused into the
+compiled step (quant.py), an SLO/shape-routed pool of AOT engines
+behind one admission layer (fleet.py), and a content-addressed LRU
+feature cache in front of the batcher (cache.py). A single-engine,
+quant-off, cache-off fleet is bitwise the PR-10 engine.
 """
 
 from dinov3_tpu.serve.batcher import (
@@ -17,6 +24,11 @@ from dinov3_tpu.serve.batcher import (
     patch_coords_np,
     patchify,
 )
+from dinov3_tpu.serve.cache import (
+    FeatureCache,
+    image_key,
+    weights_fingerprint,
+)
 from dinov3_tpu.serve.engine import (
     OracleServeEngine,
     PackedServeEngine,
@@ -24,13 +36,32 @@ from dinov3_tpu.serve.engine import (
     build_serve_engine,
     serve_layout_from_cfg,
 )
+from dinov3_tpu.serve.fleet import (
+    EngineSpec,
+    FleetRouter,
+    build_serve_fleet,
+    layout_from_envelope,
+)
+from dinov3_tpu.serve.quant import (
+    QuantLeaf,
+    dequantize_tree,
+    is_quantized_tree,
+    quant_feature_drift,
+    quant_summary,
+    quantizable_path,
+    quantize_serving_tree,
+)
 from dinov3_tpu.serve.types import ServeRequest, ServeResponse
 from dinov3_tpu.serve.weights import cast_serving_tree, load_serving_model
 
 __all__ = [
-    "ContinuousBatcher", "OracleServeEngine", "PackPlan",
-    "PackedServeEngine", "ServeLayout", "ServeRequest", "ServeResponse",
-    "ServeRing", "build_serve_engine", "cast_serving_tree",
-    "load_serving_model", "patch_coords_np", "patchify",
-    "serve_layout_from_cfg",
+    "ContinuousBatcher", "EngineSpec", "FeatureCache", "FleetRouter",
+    "OracleServeEngine", "PackPlan", "PackedServeEngine", "QuantLeaf",
+    "ServeLayout", "ServeRequest", "ServeResponse", "ServeRing",
+    "build_serve_engine", "build_serve_fleet", "cast_serving_tree",
+    "dequantize_tree", "image_key", "is_quantized_tree",
+    "layout_from_envelope", "load_serving_model", "patch_coords_np",
+    "patchify", "quant_feature_drift", "quant_summary",
+    "quantizable_path", "quantize_serving_tree", "serve_layout_from_cfg",
+    "weights_fingerprint",
 ]

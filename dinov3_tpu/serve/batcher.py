@@ -67,6 +67,17 @@ class ServeLayout:
                 f"image size {(h_px, w_px)} not divisible by patch {p}")
         return self.n_prefix + (h_px // p) * (w_px // p)
 
+    def admits(self, h_px: int, w_px: int) -> bool:
+        """Whether this layout can serve an (h, w) request at all:
+        patch-divisible and the token span fits one row. The fleet
+        admission layer (serve/fleet.py FleetRouter.route) keys on
+        this — capacity, not the px advisory envelope (min_px/max_px
+        drive the pad-waste guardrail, not correctness)."""
+        p = self.patch_size
+        if h_px % p or w_px % p:
+            return False
+        return self.seq_len(h_px, w_px) <= self.row_tokens
+
 
 def patchify(image: np.ndarray, patch_size: int) -> np.ndarray:
     """[H, W, C] -> [h*w, p, p, C], PatchEmbed's patch order and

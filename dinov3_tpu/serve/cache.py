@@ -1,0 +1,138 @@
+"""Content-addressed feature cache (ROADMAP item 1c).
+
+The frozen-teacher invariant makes serving memoizable: fixed weights +
+a deterministic forward mean identical inputs yield identical
+embeddings, so repeated-content traffic (the common case at
+millions-of-users scale) can short-circuit to an O(1) host hit in
+front of the batcher. Keys are content-addressed:
+
+    (engine weights fingerprint, sha256 of shape + dtype + image bytes)
+
+- the **image hash** covers the raw pixel bytes AND the array's shape/
+  dtype header, so the same content at two resolutions (or a resize)
+  never collides — resolution is part of the key by construction;
+- the **weights fingerprint** (sha256 over every leaf's path, dtype,
+  shape, and bytes) pins entries to ONE serving tree: rebuilding an
+  engine on new weights — or the int8 tree of the same checkpoint —
+  changes the fingerprint and invalidates every prior entry without a
+  flush protocol (fingerprint-invalidation pinned in
+  tests/test_fleet.py).
+
+The store is a bounded LRU (``collections.OrderedDict`` move-to-end on
+hit, evict-oldest on overflow) holding the response feature arrays
+exactly as the engine fetched them — a hit returns the SAME float32
+buffers a miss produced, so hit/miss bitwise equality is by
+construction, and asserted anyway in the fleet bench + CI smoke.
+Hit/miss/eviction/insert counters flow into the PR-11 span stream
+through ``ServeObserver.on_cache`` (telemetry/serve_obs.py) and into
+every fleet bench record (bench.py ``_fleet_summary``). Capacity is
+guarded by ``warn_cache_memory`` (configs/config.py): capacity x
+per-entry feature bytes vs the host budget.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+import numpy as np
+
+
+def image_key(image) -> str:
+    """sha256 of one request image: shape + dtype header, then the raw
+    bytes. Deterministic across identical submissions (hash stability
+    is pinned in tests/test_fleet.py) and resolution-discriminating by
+    the header."""
+    a = np.ascontiguousarray(image)
+    h = hashlib.sha256()
+    h.update(repr((a.shape, str(a.dtype))).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def weights_fingerprint(params) -> str:
+    """sha256 over the serving tree's (path, dtype, shape, bytes) in
+    flatten order — ints, bf16, int8 codes and f32 scales all included,
+    so ANY weight change (new checkpoint, quantization on/off) yields a
+    new fingerprint and a cold cache for that engine."""
+    import jax.tree_util as jtu
+
+    h = hashlib.sha256()
+    for path, leaf in jtu.tree_flatten_with_path(params)[0]:
+        a = np.asarray(leaf)
+        h.update(jtu.keystr(path).encode())
+        h.update(repr((a.shape, str(a.dtype))).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:16]
+
+
+class FeatureCache:
+    """Bounded LRU of computed features, keyed content-addressed.
+
+    Values are ``(cls_feature, pooled_patch_feature, n_patches)`` —
+    the response payload minus per-request metadata. ``get`` refreshes
+    recency; ``put`` evicts the least-recently-used entry past
+    ``capacity`` and returns whether it evicted (the router forwards
+    that to the observer's eviction counter)."""
+
+    def __init__(self, capacity: int):
+        capacity = int(capacity)
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._d: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.inserts = 0
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def key(self, image, fingerprint: str) -> tuple:
+        return (str(fingerprint), image_key(image))
+
+    def get(self, key):
+        val = self._d.get(key)
+        if val is None:
+            self.misses += 1
+            return None
+        self._d.move_to_end(key)
+        self.hits += 1
+        return val
+
+    def put(self, key, value) -> bool:
+        """Insert (or refresh) one entry; True when an LRU eviction made
+        room. Stored arrays are frozen (writeable=False) so a caller
+        mutating a hit response cannot poison later hits."""
+        cls, pooled, n_patches = value
+        cls = np.asarray(cls)
+        pooled = np.asarray(pooled)
+        cls.flags.writeable = False
+        pooled.flags.writeable = False
+        if key in self._d:
+            self._d.move_to_end(key)
+        self._d[key] = (cls, pooled, int(n_patches))
+        self.inserts += 1
+        if len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+            self.evictions += 1
+            return True
+        return False
+
+    def clear(self, reset_counters: bool = False) -> None:
+        self._d.clear()
+        if reset_counters:
+            self.hits = self.misses = self.evictions = self.inserts = 0
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "capacity": self.capacity,
+            "entries": len(self._d),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "inserts": self.inserts,
+            "hit_rate": round(self.hits / total, 4) if total else None,
+        }

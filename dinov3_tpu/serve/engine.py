@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dinov3_tpu.serve.batcher import ContinuousBatcher, PackPlan, ServeLayout
+from dinov3_tpu.serve.quant import dequantize_tree, is_quantized_tree
 from dinov3_tpu.serve.types import ServeRequest, ServeResponse
 
 
@@ -84,6 +85,13 @@ def make_serve_step(model, n_slots: int):
 
     def step(params, ring, patches, coords, prefix_idx, seg, cls_index,
              slot, stamp):
+        # int8 trees (serve/quant.py QuantLeaf) expand to bf16 INSIDE
+        # the compiled program — dequant is fused ahead of the matmuls,
+        # the host holds only codes + scales, and the census attributes
+        # any expansion copies to "serve" (utils.classify_copy). A
+        # dense tree passes through untouched.
+        with jax.named_scope("serve_dequant"):
+            params = dequantize_tree(params)
         out = model.apply({"params": params}, patches, coords, prefix_idx,
                           seg, method="packed_feature_forward")
         with jax.named_scope("serve_extract"):
@@ -119,8 +127,6 @@ def make_serve_step(model, n_slots: int):
 class PackedServeEngine:
     """Continuous-packing engine: ragged traffic, one compiled program."""
 
-    arm = "packed"
-
     def __init__(self, model, params, layout: ServeLayout,
                  flush_ms: float = 10.0, ring_depth: int = 2,
                  warn: bool = True):
@@ -133,6 +139,11 @@ class PackedServeEngine:
         self.model = model
         self.params = params
         self.layout = layout
+        # int8 trees carry QuantLeaf kernels (serve/quant.py); the arm
+        # label and dtype ride every bench record (_fleet_summary)
+        self.weights_dtype = "int8" if is_quantized_tree(params) else "bf16"
+        self.arm = ("packed_int8" if self.weights_dtype == "int8"
+                    else "packed")
         self.batcher = ContinuousBatcher(layout, flush_ms=flush_ms)
         self.ring_depth = int(ring_depth)
         self._slot = 0

@@ -1,0 +1,350 @@
+"""Engine pool + admission layer (ROADMAP item 1b): many AOT engines,
+one front door.
+
+Production traffic is many shapes and many SLOs; PR 10's engine is one
+token-budget envelope. ``FleetRouter`` puts several
+``PackedServeEngine``s — e.g. a small-image fast lane at a tight
+rows x row_tokens next to the full 512px row, bf16 and int8 weight
+variants (serve/quant.py) — behind one admission layer that speaks the
+SAME submit/should_flush/flush protocol as a single engine, so every
+existing replay harness (scripts/bench_serve.py drain_all /
+rated_replay) drives a fleet unchanged.
+
+Admission is deterministic, by request shape + SLO class: among the
+engines whose layout ADMITS the request (patch-divisible and the token
+span fits one row — ``ServeLayout.admits``), engines explicitly
+listing the request's SLO class are preferred over catch-alls
+(``slo_classes=None``), then the smallest token budget wins (the fast
+lane takes the small interactive traffic it was derived for; the full
+row takes the rest). No admitting engine is a hard error — the fleet's
+envelope, not a silent fallback.
+
+Per-engine envelopes come from MEASURED traffic, not build-time
+guesses: ``layout_from_envelope`` turns a
+``LiveMixTracker.recommended_serve_envelope()`` dict (the PR-11
+live-mix telemetry) into a fast-lane ``ServeLayout``, and
+``FleetRouter.check_drift()`` re-fires the pad-waste drift check per
+engine as the live mix evolves.
+
+The content-addressed cache (serve/cache.py) sits in FRONT of the
+engines: a hit short-circuits at submit (the batcher never sees the
+request) into ``_ready``, drained by the next ``flush()``; a miss is
+remembered and inserted when its engine response lands. Keys carry the
+target engine's weights fingerprint, so bf16 and int8 variants of the
+same checkpoint never share entries. Hit/miss/eviction events and
+route counts flow to a fleet-level ``ServeObserver``
+(telemetry/serve_obs.py ``on_cache``/``on_route``) into the one span
+stream.
+
+Oracle path: a single-engine, quant-off, cache-off fleet is
+bit-for-bit the PR-10 ``PackedServeEngine`` (same engine code, the
+router adds only the engine tag) — pinned in tests/test_fleet.py, the
+repo's legacy-path-as-oracle convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dinov3_tpu.serve.batcher import ServeLayout
+from dinov3_tpu.serve.cache import FeatureCache, weights_fingerprint
+from dinov3_tpu.serve.types import ServeResponse
+
+
+@dataclasses.dataclass
+class EngineSpec:
+    """One pool member: the engine, its routing contract, and the
+    weights fingerprint its cache entries are keyed under.
+    ``slo_classes=None`` = serves any class (the catch-all); a tuple
+    restricts admission preference to those classes."""
+
+    name: str
+    engine: object
+    slo_classes: tuple | None = None
+    fingerprint: str = ""
+
+
+def layout_from_envelope(base: ServeLayout, env: dict) -> ServeLayout:
+    """A ``recommended_serve_envelope()`` dict (telemetry/serve_obs.py)
+    -> a derived ``ServeLayout``: row shape and segment slots from the
+    simulated-FFD search, px bounds from the observed mix when the
+    tracker saw them — the measured-traffic fast lane."""
+    kw = {
+        "rows": int(env["rows"]),
+        "row_tokens": int(env["row_tokens"]),
+        "max_segments_per_row": int(env["max_segments_per_row"]),
+    }
+    if "min_px" in env:
+        kw["min_px"] = int(env["min_px"])
+    if "max_px" in env:
+        kw["max_px"] = int(env["max_px"])
+    return dataclasses.replace(base, **kw)
+
+
+class FleetRouter:
+    """The admission layer: routes, caches, tags, and aggregates.
+
+    Speaks the single-engine protocol (submit / queue_len /
+    should_flush / flush_deadline / flush), so callers written against
+    ``PackedServeEngine`` drive a fleet unchanged. ``flush(now)`` runs
+    one pack on every engine due at ``now`` (all queued engines when
+    ``now`` is None — drain semantics) and prepends any cache hits
+    ready since the last flush."""
+
+    def __init__(self, specs: list, cache: FeatureCache | None = None,
+                 observer=None):
+        if not specs:
+            raise ValueError("FleetRouter needs at least one EngineSpec")
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate engine names: {names}")
+        for s in specs:
+            if not s.fingerprint:
+                s.fingerprint = weights_fingerprint(s.engine.params)
+        self.specs = list(specs)
+        self.cache = cache
+        self.observer = observer
+        self.route_counts: dict[tuple, int] = {}
+        self._ready: list[ServeResponse] = []
+        self._pending_keys: dict[tuple, tuple] = {}
+
+    # ---------------- admission ----------------
+
+    def route(self, slo: str, h_px: int, w_px: int) -> EngineSpec:
+        """Deterministic admission: admitting engines only; prefer an
+        explicit SLO match over catch-alls; smallest token budget, then
+        spec order, breaks ties."""
+        fits = [(i, s) for i, s in enumerate(self.specs)
+                if s.engine.layout.admits(h_px, w_px)]
+        if not fits:
+            raise ValueError(
+                f"no engine admits a {h_px}x{w_px} request (slo={slo!r}); "
+                f"fleet envelopes: "
+                + ", ".join(f"{s.name}: row_tokens="
+                            f"{s.engine.layout.row_tokens}"
+                            for s in self.specs))
+        explicit = [(i, s) for i, s in fits
+                    if s.slo_classes is not None and str(slo) in s.slo_classes]
+        pool = explicit or [(i, s) for i, s in fits
+                            if s.slo_classes is None] or fits
+        return min(pool, key=lambda t: (t[1].engine.layout.token_budget,
+                                        t[0]))[1]
+
+    def submit(self, image, request_id: int, arrival_s: float = 0.0,
+               slo: str = "default") -> None:
+        import numpy as np
+
+        image = np.asarray(image, np.float32)
+        h, w = int(image.shape[0]), int(image.shape[1])
+        spec = self.route(slo, h, w)
+        key = (spec.name, str(slo))
+        self.route_counts[key] = self.route_counts.get(key, 0) + 1
+        if self.observer is not None:
+            self.observer.on_route(spec.name, slo)
+        if self.cache is not None:
+            ckey = self.cache.key(image, spec.fingerprint)
+            val = self.cache.get(ckey)
+            if val is not None:
+                cls, pooled, n_patches = val
+                self._ready.append(ServeResponse(
+                    request_id=request_id, cls_feature=cls,
+                    pooled_patch_feature=pooled, n_patches=n_patches,
+                    arrival_s=arrival_s, slo=slo, engine=spec.name,
+                    cache_hit=True))
+                if self.observer is not None:
+                    self.observer.on_cache("hit", request_id=request_id,
+                                           slo=slo, engine=spec.name)
+                return
+            self._pending_keys[(spec.name, int(request_id))] = ckey
+            if self.observer is not None:
+                self.observer.on_cache("miss", request_id=request_id,
+                                       slo=slo, engine=spec.name)
+        spec.engine.submit(image, request_id, arrival_s=arrival_s, slo=slo)
+
+    # ---------------- the single-engine protocol ----------------
+
+    @property
+    def queue_len(self) -> int:
+        return len(self._ready) + sum(s.engine.queue_len
+                                      for s in self.specs)
+
+    def should_flush(self, now: float) -> bool:
+        return bool(self._ready) or any(s.engine.should_flush(now)
+                                        for s in self.specs)
+
+    def flush_deadline(self):
+        deadlines = [d for s in self.specs
+                     if (d := s.engine.flush_deadline()) is not None]
+        return min(deadlines) if deadlines else None
+
+    def flush(self, now: float | None = None) -> list[ServeResponse]:
+        """Cache hits ready since the last call, then one pack from
+        every engine that is due (``now`` given) or queued (drain)."""
+        out = self._ready
+        self._ready = []
+        for spec in self.specs:
+            due = (spec.engine.queue_len if now is None
+                   else spec.engine.should_flush(now))
+            if not due:
+                continue
+            for r in spec.engine.flush():
+                r.engine = spec.name
+                pkey = self._pending_keys.pop(
+                    (spec.name, int(r.request_id)), None)
+                if pkey is not None and self.cache is not None:
+                    evicted = self.cache.put(
+                        pkey, (r.cls_feature, r.pooled_patch_feature,
+                               r.n_patches))
+                    if self.observer is not None:
+                        self.observer.on_cache("insert",
+                                               request_id=r.request_id,
+                                               slo=r.slo, engine=spec.name)
+                        if evicted:
+                            self.observer.on_cache("evict",
+                                                   engine=spec.name)
+                out.append(r)
+        return out
+
+    # ---------------- accounting ----------------
+
+    @property
+    def compile_count(self) -> int:
+        return sum(s.engine.compile_count for s in self.specs)
+
+    def check_drift(self, threshold: float = 0.15,
+                    warn: bool = True) -> dict:
+        """Re-fire the per-engine live-mix pad-waste drift check (the
+        PR-11 ``LiveMixTracker.check_drift``) for every engine with an
+        attached observer; {engine: warning-or-None}."""
+        out = {}
+        for s in self.specs:
+            obs = getattr(s.engine, "observer", None)
+            if obs is not None:
+                out[s.name] = obs.mix.check_drift(
+                    threshold=threshold, warn=warn, stacklevel=3)
+        return out
+
+    def finalize(self) -> dict:
+        """Route/cache accounting for the bench record (bench.py
+        ``_fleet_summary`` embeds this shape); emits one
+        ``serve_fleet`` record into the span stream when an observer is
+        attached."""
+        out = {
+            "n_engines": len(self.specs),
+            "compile_count_total": self.compile_count,
+            "route_counts": {f"{en}/{slo}": c for (en, slo), c
+                             in sorted(self.route_counts.items())},
+            "cache": self.cache.stats() if self.cache is not None else None,
+        }
+        if self.observer is not None:
+            import time
+
+            self.observer.emit({"name": "serve_fleet",
+                                "t": round(time.time(), 6), **out})
+        return out
+
+
+# ---------------- config-level construction ----------------
+
+
+def _engine_layout(base: ServeLayout, overlay: dict) -> ServeLayout:
+    kw = {}
+    for k in ("rows", "row_tokens", "max_segments_per_row",
+              "min_px", "max_px"):
+        v = overlay.get(k)
+        if v is not None:
+            kw[k] = int(v)
+    return dataclasses.replace(base, **kw) if kw else base
+
+
+def build_serve_fleet(cfg, params=None, ckpt_dir: str | None = None,
+                      warn: bool = True, observer=None):
+    """The config-level fleet entry: one restore (any checkpoint arm,
+    serve/weights.py), one optional int8 quantization of that tree
+    (serve.quant), N engines from ``serve.fleet.engines`` overlays
+    (None = a single default engine — the PR-10 oracle path), and the
+    content-addressed cache in front (serve.cache).
+
+    Every quantized engine's CLS drift vs the bf16 tree is measured at
+    build (serve/quant.py ``quant_feature_drift``) and fired through
+    ``warn_quant_drift`` against ``serve.quant.drift_tol``; the cache
+    capacity is fired through ``warn_cache_memory`` against the host
+    budget. Returns the ``FleetRouter``."""
+    from dinov3_tpu.configs.config import (
+        serve_cache_wished,
+        serve_quant_wished,
+        warn_cache_memory,
+        warn_quant_drift,
+    )
+    from dinov3_tpu.serve.engine import (
+        PackedServeEngine,
+        serve_layout_from_cfg,
+    )
+    from dinov3_tpu.serve.quant import (
+        quant_feature_drift,
+        quantize_serving_tree,
+    )
+    from dinov3_tpu.serve.weights import load_serving_model
+
+    model, sparams = load_serving_model(cfg, ckpt_dir=ckpt_dir,
+                                        params=params)
+    base_layout = serve_layout_from_cfg(cfg, model)
+    s = cfg.get("serve") or {}
+    base_flush_ms = float(s.get("flush_ms", 10.0) or 10.0)
+    ring_depth = int(s.get("ring_depth", 2) or 2)
+    qcfg = s.get("quant") or {}
+    default_quant = serve_quant_wished(cfg)
+    tol = float(qcfg.get("drift_tol", 0.05) or 0.05)
+
+    engines_cfg = (s.get("fleet") or {}).get("engines") or None
+    if not engines_cfg:
+        engines_cfg = [{"name": "default"}]
+
+    qtree = None
+    drift = None
+    specs = []
+    for i, e in enumerate(engines_cfg):
+        e = dict(e)
+        name = str(e.get("name") or f"engine{i}")
+        layout = _engine_layout(base_layout, e)
+        use_quant = bool(e.get("quant", default_quant))
+        tree = sparams
+        if use_quant:
+            if qtree is None:
+                qtree = quantize_serving_tree(sparams)
+                probe_px = int(qcfg.get("probe_px", 0) or 0)
+                if probe_px <= 0:
+                    p = base_layout.patch_size
+                    probe_px = max(p, (min(base_layout.max_px, 224)
+                                       // p) * p)
+                drift = quant_feature_drift(model, sparams, qtree,
+                                            px=probe_px)
+                if warn:
+                    warn_quant_drift(
+                        drift["cls_max_abs_diff"], tol=tol,
+                        axis=f"int8 serving tree, {probe_px}px CLS probe")
+            tree = qtree
+        slo = e.get("slo")
+        if isinstance(slo, str):
+            slo = tuple(c.strip() for c in slo.split(",") if c.strip())
+        elif slo is not None:
+            slo = tuple(str(c) for c in slo)
+        eng = PackedServeEngine(
+            model, tree, layout,
+            flush_ms=float(e.get("flush_ms", base_flush_ms)),
+            ring_depth=ring_depth, warn=warn)
+        specs.append(EngineSpec(name=name, engine=eng, slo_classes=slo))
+
+    cache = None
+    if serve_cache_wished(cfg):
+        ccfg = s.get("cache") or {}
+        capacity = int(ccfg.get("capacity", 4096) or 4096)
+        if warn:
+            warn_cache_memory(
+                capacity, model.embed_dim,
+                budget_mb=float(ccfg.get("host_budget_mb", 1024) or 1024))
+        cache = FeatureCache(capacity)
+
+    router = FleetRouter(specs, cache=cache, observer=observer)
+    router.quant_drift = drift
+    return router

@@ -1,0 +1,169 @@
+"""int8 weight quantization for the serve engines (ROADMAP item 1a).
+
+Per-channel symmetric int8 over the attn/mlp matmul KERNELS — the same
+leaf set ``stream_castable_path`` (ops/block.py) marks safe to cast to
+the compute dtype, narrowed to the ``*kernel`` leaves (biases, norm
+scales, layerscale gammas, and the MoE router stay bf16: they are tiny,
+and norm/router numerics are deliberately not cast even to bf16).
+Scales are per OUTPUT channel — ``amax(|W|, axis=-2)/127`` — which is
+the reduction-free axis of every kernel here ([in, out] per module,
+[L, in, out] when the block scan stacks them), so dequantization is one
+broadcasted multiply.
+
+Quantization happens ONCE at engine build, on the host, in f32
+numpy — deterministic round-half-to-even, no RNG, no jit — so the same
+bf16 serving tree always yields the same (q, scale) pair bitwise
+whatever checkpoint arm it restored from (the four-arm equality of
+serve/weights.py carries through; pinned in tests/test_serve.py).
+Dequantization is fused into the compiled serve step
+(serve/engine.py ``make_serve_step``, ``serve_dequant`` named scope):
+``(q_int8 * scale_f32).astype(bf16)`` per leaf, a cheap elementwise
+preamble XLA folds ahead of the matmuls — the engine still makes
+exactly ONE compile, and int8 trees halve the resident weight bytes.
+
+Feature drift vs the bf16 arm is measured at build by
+``quant_feature_drift`` (one jitted CLS forward, called twice — same
+program for both trees) and fired through ``warn_quant_drift``
+(configs/config.py) when it exceeds ``serve.quant.drift_tol`` — the
+same pin-against-the-wider-dtype discipline bf16 serving was held to
+against fp32 (tests/test_serve.py feature-equivalence tolerances).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QuantLeaf(NamedTuple):
+    """One quantized kernel: int8 codes + per-output-channel f32 scale
+    (``scale`` keeps the kernel's rank with the reduced axis at size 1,
+    so dequant is a plain broadcast). A NamedTuple, so it is a pytree
+    node — quantized trees flow through jit/AOT lowering unchanged."""
+
+    q: jnp.ndarray      # int8, the kernel's shape
+    scale: jnp.ndarray  # f32, kernel shape with axis -2 reduced to 1
+
+
+def quantizable_path(path) -> bool:
+    """Whether the leaf at ``path`` is int8-quantized: an attn/mlp
+    matmul kernel by the stream-castable rule (ops/block.py), excluding
+    everything else castable (biases) — matmul weights only."""
+    from dinov3_tpu.ops.block import stream_castable_path
+
+    if not path or not stream_castable_path(path):
+        return False
+    last = str(getattr(path[-1], "key", getattr(path[-1], "idx", path[-1])))
+    return "kernel" in last
+
+
+def quantize_leaf(w) -> QuantLeaf:
+    """f32 host quantization of one kernel: symmetric per-output-channel
+    scale ``amax(|w|, axis=-2)/127`` (zero channels get scale 1.0 so the
+    divide is exact and dequant returns exact zeros), codes rounded
+    half-to-even and clipped to [-127, 127] (symmetric: -128 unused)."""
+    w32 = np.asarray(w).astype(np.float32)
+    amax = np.max(np.abs(w32), axis=-2, keepdims=True)
+    scale = np.where(amax > 0, amax / np.float32(127.0), np.float32(1.0))
+    scale = scale.astype(np.float32)
+    q = np.clip(np.rint(w32 / scale), -127, 127).astype(np.int8)
+    return QuantLeaf(q=jnp.asarray(q), scale=jnp.asarray(scale))
+
+
+def quantize_serving_tree(params):
+    """bf16 serving tree -> mixed tree with ``QuantLeaf`` at every
+    ``quantizable_path`` kernel, all other leaves untouched (still the
+    bf16 leaves ``cast_serving_tree`` produced). Idempotent on already-
+    quantized trees (QuantLeafs pass through)."""
+    import jax.tree_util as jtu
+
+    def one(path, leaf):
+        if isinstance(leaf, QuantLeaf):
+            return leaf
+        if quantizable_path(path):
+            return quantize_leaf(leaf)
+        return leaf
+
+    return jtu.tree_map_with_path(
+        one, params, is_leaf=lambda x: isinstance(x, QuantLeaf))
+
+
+def dequantize_tree(params, dtype=jnp.bfloat16):
+    """QuantLeaf -> dense kernel in the serving dtype (everything else
+    passes through). Traceable: the serve step calls this INSIDE the
+    compiled program (``serve_dequant`` scope), so dequant is fused into
+    the one AOT forward and the host never holds dense int8-derived
+    kernels."""
+
+    def one(leaf):
+        if isinstance(leaf, QuantLeaf):
+            return (leaf.q.astype(jnp.float32) * leaf.scale).astype(dtype)
+        return leaf
+
+    return jax.tree.map(one, params,
+                        is_leaf=lambda x: isinstance(x, QuantLeaf))
+
+
+def is_quantized_tree(params) -> bool:
+    return any(isinstance(l, QuantLeaf)
+               for l in jax.tree.leaves(
+                   params, is_leaf=lambda x: isinstance(x, QuantLeaf)))
+
+
+def quant_summary(params) -> dict:
+    """Byte accounting of a (possibly) quantized tree: resident weight
+    bytes vs the dense-bf16 equivalent, and how many kernels are int8 —
+    the record block bench.py embeds per engine."""
+    n_quant = n_leaves = 0
+    bytes_resident = bytes_bf16 = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantLeaf)):
+        n_leaves += 1
+        if isinstance(leaf, QuantLeaf):
+            n_quant += 1
+            bytes_resident += leaf.q.size + leaf.scale.size * 4
+            bytes_bf16 += leaf.q.size * 2
+        else:
+            sz = int(np.prod(leaf.shape)) if hasattr(leaf, "shape") else 0
+            b = sz * jnp.dtype(leaf.dtype).itemsize if sz else 0
+            bytes_resident += b
+            bytes_bf16 += b
+    return {
+        "quantized_kernels": n_quant,
+        "n_leaves": n_leaves,
+        "weight_bytes": int(bytes_resident),
+        "bf16_weight_bytes": int(bytes_bf16),
+        "bytes_ratio": (round(bytes_resident / bytes_bf16, 4)
+                        if bytes_bf16 else 1.0),
+    }
+
+
+def quant_feature_drift(model, bf16_params, qparams, px: int,
+                        seed: int = 0) -> dict:
+    """Measured int8-vs-bf16 feature drift: ONE jitted plain forward
+    (the oracle extraction path — CLS + mean-pooled patches), called on
+    the bf16 tree and the dequantized int8 tree. Both calls share the
+    program (same shapes/dtypes after dequant), so the probe costs one
+    compile, OUTSIDE the engine's pinned AOT program. Returns max |diff|
+    per feature view — the number ``warn_quant_drift`` gates on at
+    engine build (serve/fleet.py)."""
+    x = jax.random.normal(jax.random.key(seed), (1, int(px), int(px), 3),
+                          jnp.float32)
+
+    @jax.jit
+    def feats(p):
+        out = model.apply({"params": p}, x, crop_kind="global",
+                          deterministic=True)
+        return (out["x_norm_clstoken"].astype(jnp.float32),
+                out["x_norm_patchtokens"].astype(jnp.float32).mean(1))
+
+    cls_a, pooled_a = feats(bf16_params)
+    cls_b, pooled_b = feats(dequantize_tree(qparams))
+    return {
+        "probe_px": int(px),
+        "cls_max_abs_diff": float(jnp.abs(cls_a - cls_b).max()),
+        "pooled_max_abs_diff": float(jnp.abs(pooled_a - pooled_b).max()),
+    }

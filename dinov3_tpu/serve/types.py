@@ -48,6 +48,12 @@ class ServeResponse:
     arrival_s: float = 0.0
     done_s: float = 0.0
     slo: str = "default"
+    # fleet provenance (serve/fleet.py FleetRouter): which pool engine
+    # served the request ("" outside a fleet) and whether the features
+    # came from the content-addressed cache (serve/cache.py) instead of
+    # a forward — the per-request record the hit-rate sweep audits
+    engine: str = ""
+    cache_hit: bool = False
 
     @property
     def latency_s(self) -> float:

@@ -24,15 +24,21 @@ import jax.numpy as jnp
 
 def cast_serving_tree(params, dtype=jnp.bfloat16):
     """Cast every floating leaf to the serving dtype (ints — e.g. MoE
-    counters — pass through). Idempotent and deterministic."""
+    counters — pass through; already-quantized ``QuantLeaf`` kernels
+    keep their int8 codes + f32 scales untouched, serve/quant.py).
+    Idempotent and deterministic."""
+    from dinov3_tpu.serve.quant import QuantLeaf
 
     def cast(leaf):
+        if isinstance(leaf, QuantLeaf):
+            return leaf
         leaf = jnp.asarray(leaf)
         if jnp.issubdtype(leaf.dtype, jnp.floating):
             return leaf.astype(dtype)
         return leaf
 
-    return jax.tree.map(cast, params)
+    return jax.tree.map(cast, params,
+                        is_leaf=lambda x: isinstance(x, QuantLeaf))
 
 
 def serving_config(cfg):

@@ -38,6 +38,13 @@ added host syncs):
   the build-time envelope — the direct prerequisite for the ROADMAP
   item-1 engine pool's per-engine envelopes.
 
+The fleet layer (ISSUE 12, serve/fleet.py) reports through the same
+observer: ``on_route`` counts admission decisions per (engine, SLO)
+and ``on_cache`` counts + emits ``serve_cache`` records for the
+content-addressed cache's hit/miss/insert/evict events — cache hits
+never reach a pack, so their ``serve_cache`` record is their
+per-request trace.
+
 Window discipline: every ``window_packs`` packs the observer rolls the
 mix window into the EWMA, beats the serve heartbeat
 (``heartbeat.serve[.rankN]``, telemetry/watchdog.py), flushes the span
@@ -277,6 +284,13 @@ class ServeObserver:
         self.labels: dict = {}
         self.packs = 0
         self.requests = 0
+        # fleet-plane counters (ISSUE 12): the FleetRouter
+        # (serve/fleet.py) reports cache hit/miss/insert/evict events
+        # and per-(engine, SLO) route decisions here, so the one span
+        # stream carries the admission layer's story next to the
+        # per-request phase spans
+        self.cache_events: dict[str, int] = {}
+        self.route_counts: dict[str, int] = {}
         self._pending: dict[int, tuple[str, float]] = {}
         self._window_t0 = time.perf_counter()
 
@@ -369,6 +383,27 @@ class ServeObserver:
         if self.packs % self.window_packs == 0:
             self.roll_window()
 
+    def on_route(self, engine: str, slo: str) -> None:
+        """One admission decision (serve/fleet.py FleetRouter.route):
+        counted per "engine/slo" — the route mix the fleet bench record
+        embeds (bench.py _fleet_summary)."""
+        key = f"{engine}/{slo}"
+        self.route_counts[key] = self.route_counts.get(key, 0) + 1
+
+    def on_cache(self, event: str, request_id: int | None = None,
+                 slo: str | None = None, engine: str | None = None) -> None:
+        """One feature-cache event (``hit``/``miss``/``insert``/
+        ``evict``, serve/cache.py): counted, and emitted as a
+        ``serve_cache`` record so cache behaviour lands in the span
+        stream per request (hits carry the rid that never reached a
+        pack — their only per-request record)."""
+        event = str(event)
+        self.cache_events[event] = self.cache_events.get(event, 0) + 1
+        self.emit({"name": "serve_cache", "event": event,
+                   "rid": None if request_id is None else int(request_id),
+                   "slo": slo, "engine": engine,
+                   "t": round(time.time(), 6)})
+
     def observe_latency(self, slo: str, latency_s: float,
                         request_id: int | None = None) -> None:
         """End-to-end latency on the CALLER's clock (virtual in the
@@ -438,6 +473,10 @@ class ServeObserver:
         self.emit(mix_rec)
         out["ewma_pad_waste"] = self.mix.ewma_pad_waste
         out["recommended_envelope"] = env
+        if self.cache_events:
+            out["cache_events"] = dict(sorted(self.cache_events.items()))
+        if self.route_counts:
+            out["route_counts"] = dict(sorted(self.route_counts.items()))
         if self.tracer is not None:
             self.tracer.beat(self.packs)
         return out

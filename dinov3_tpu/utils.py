@@ -310,9 +310,11 @@ def classify_copy(line: str) -> str:
       per-segment extraction, and donated output ring (the
       ``serve_pack``/``serve_extract``/``serve_ring`` named scopes in
       models/vision_transformer.py packed_feature_forward and
-      serve/engine.py make_serve_step) — the token/feature-plane
-      traffic continuous packing introduces, attributed so the serve
-      step's census ceiling names it (scripts/bench_serve.py pins zero
+      serve/engine.py make_serve_step, plus the ``serve_dequant``
+      int8->bf16 weight expansion scope of quantized engines,
+      serve/quant.py) — the token/feature-plane traffic continuous
+      packing introduces, attributed so the serve step's census
+      ceiling names it (scripts/bench_serve.py pins zero
       unattributed).
     - "rng": u32 results of <= 8 elements — threefry key/counter
       plumbing (keys are u32[2]/u32[4]; fold_in intermediates scalar).
@@ -337,7 +339,7 @@ def classify_copy(line: str) -> str:
             or "bucket_stream" in line):
         return "bucket"
     if ("serve_pack" in line or "serve_extract" in line
-            or "serve_ring" in line):
+            or "serve_ring" in line or "serve_dequant" in line):
         return "serve"
     shp = _hlo_result_shape(line)
     if shp is None:

@@ -181,9 +181,37 @@ def main() -> int:
     }
 
     arms = ("packed", "oracle_rectangular", "oracle_per_image")
-    n = int(serve["n_per_mix"])
+    # a fleet record (bench_serve.py --fleet, SERVE_r16) has no 3-arm
+    # "mixes" census — fold its pins through instead of KeyError-ing
+    n = int(serve.get("n_per_mix") or serve.get("n_per_sweep") or 0)
     worst_ratio = 1.0
-    for mix_name, mix_rec in serve["mixes"].items():
+    if serve.get("fleet") is not None:
+        fleet = serve["fleet"]
+        cache_events: dict = {}
+        for r in records:
+            if r["name"] == "serve_cache":
+                ev = r.get("event")
+                cache_events[ev] = cache_events.get(ev, 0) + 1
+        out["fleet"] = {
+            "n_engines": serve.get("n_engines"),
+            "compile_count_total": serve.get("compile_count_total"),
+            "compile_growth_total": serve.get("compile_growth_total"),
+            "forced_hit_bitwise": fleet.get("forced_hit_bitwise"),
+            "route_counts": (fleet.get("summary") or {}).get(
+                "route_counts"),
+            "cache_span_events": cache_events,
+            "sweeps": {
+                k: {"measured_hit_rate": s.get("measured_hit_rate"),
+                    "cache_hits_bitwise_equal":
+                        s.get("cache_hits_bitwise_equal"),
+                    "compile_growth": s.get("compile_growth")}
+                for k, s in (fleet.get("sweeps") or {}).items()},
+        }
+        if serve.get("compile_growth_total"):
+            raise AssertionError(
+                "fleet record shows compile growth during replay — "
+                "every engine must stay at its one AOT compile")
+    for mix_name, mix_rec in (serve.get("mixes") or {}).items():
         mix_out = {}
         for arm in arms:
             arm_rec = mix_rec.get(arm)

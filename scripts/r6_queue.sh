@@ -109,6 +109,19 @@
 #         both sides of that ratio (oracle recompiles cost more
 #         on-chip, but the packed row's O(row^2) dense attention
 #         meets an 8x faster matmul unit).
+#   phF   quantized serving fleet A/B (the int8-weights + SLO-pool +
+#         feature-cache attack, dinov3_tpu/serve/{quant,fleet,cache}):
+#         scripts/bench_serve.py --fleet runs the bf16-vs-int8
+#         single-engine control (same layout, paired best-of-k drains,
+#         CLS drift pinned under serve.quant.drift_tol) and the
+#         2-engine SLO-routed fleet with the content-addressed cache
+#         swept over hit rates {0, 0.5, 0.9}, every hit audited
+#         bitwise against its miss and total compiles pinned at
+#         n_engines. CPU-side accounting (SERVE_r16.json): int8 >=
+#         bf16 img/s at ~1e-8 CLS drift, 0.56x weight bytes; this
+#         measures what 8x-faster TPU matmul + HBM bandwidth do to
+#         the dequant-fused row (the serve_dequant census category
+#         rides in the record via BENCH_CENSUS=1).
 # Every bench.py record now embeds the fixed calibration rung
 # ("calib"), so these rows are comparable across sessions.
 #
@@ -324,6 +337,25 @@ if gate_phase 3000 phE_serve_packing; then
     else
         note "FAIL  phE_serve_packing rc=$?"
         echo "{\"tag\": \"phE_serve_packing\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
+    fi
+fi
+
+# phF: quantized serving fleet A/B. One process runs the int8-vs-bf16
+# single-engine control AND the 2-engine SLO-routed fleet + cache
+# sweep (same session, shared model build); the record embeds the
+# drift probe, per-(engine, SLO) p50/p99 and the compile pins, so the
+# whole A/B is one JSON object.
+if gate_phase 3000 phF_serve_fleet; then
+    note "start phF_serve_fleet"
+    rm -f /tmp/serve_fleet_r6.json
+    if env BENCH_CENSUS=1 timeout 3000 python scripts/bench_serve.py \
+            --fleet --out /tmp/serve_fleet_r6.json >> "$LOG" 2>&1; then
+        note "done  phF_serve_fleet -> /tmp/serve_fleet_r6.json"
+        line=$(python -c "import json,sys; print(json.dumps(json.load(open('/tmp/serve_fleet_r6.json'))))")
+        echo "{\"tag\": \"phF_serve_fleet\", \"rc\": 0, \"result\": $line}" >> "$RESULTS"
+    else
+        note "FAIL  phF_serve_fleet rc=$?"
+        echo "{\"tag\": \"phF_serve_fleet\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
     fi
 fi
 

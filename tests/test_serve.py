@@ -339,6 +339,32 @@ def test_serving_tree_from_all_four_arms(tmp_path, eight_devices):
     floats = [l for _, l in ref if jnp.issubdtype(l.dtype, jnp.floating)]
     assert floats and all(l.dtype == jnp.bfloat16 for l in floats)
 
+    # int8 quantization is a pure function of the serving tree, so the
+    # four arms must also quantize identically — bitwise q AND scale
+    # (the fleet's weights fingerprint keys the feature cache on this)
+    from dinov3_tpu.serve import (
+        QuantLeaf,
+        quantize_serving_tree,
+        weights_fingerprint,
+    )
+
+    qtrees = {n: quantize_serving_tree(t) for n, t in trees.items()}
+    qflat = {n: jtu.tree_flatten_with_path(
+        t, is_leaf=lambda x: isinstance(x, QuantLeaf))[0]
+        for n, t in qtrees.items()}
+    qref = qflat["replicated"]
+    assert any(isinstance(l, QuantLeaf) for _, l in qref)
+    for name in ("flat", "bucketed", "zero3"):
+        for (path, a), (_, b) in zip(qref, qflat[name]):
+            if isinstance(a, QuantLeaf):
+                assert np.array_equal(np.asarray(a.q), np.asarray(b.q)), (
+                    f"replicated vs {name}: {jtu.keystr(path)} q")
+                assert np.array_equal(np.asarray(a.scale),
+                                      np.asarray(b.scale)), (
+                    f"replicated vs {name}: {jtu.keystr(path)} scale")
+    fps = {weights_fingerprint(t) for t in qtrees.values()}
+    assert len(fps) == 1
+
 
 def test_cast_serving_tree_deterministic(tiny_serve):
     cfg, model, params, _ = tiny_serve
