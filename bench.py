@@ -63,6 +63,18 @@ all-reduce-vs-reduce-scatter grad-sync story straight from
 ``collective_census.by_class``; use the env form under supervision,
 argv does not propagate to the measurement child).
 
+BENCH_TRACE=1 (or ``--trace``): after the measured loop, capture a
+jax.profiler window over BENCH_TRACE_STEPS (4) extra steps of the SAME
+compiled program and embed the step-anatomy summary
+(telemetry/anatomy.py — per-scope collective ms, measured
+exposed/overlapped fraction, straggler spread across device timelines)
+in the record next to the copy/collective censuses; the
+warn_exposed_comm guardrail fires against the measurement and lands in
+the record as "exposed_comm_warning". The window is deliberately
+OUTSIDE the timed loop so profiling overhead never pollutes the
+headline img/s number. BENCH_TRACE_DIR pins the trace output dir
+(default: a fresh /tmp dir, path recorded).
+
 The benched step is the DEFAULT program, which under async telemetry
 (telemetry.async_metrics auto=on) is the telemetry step — metrics row
 into a donated on-device ring, no per-step host sync. Every record
@@ -874,6 +886,57 @@ def main():
     dt = (time.perf_counter() - t0) / steps
     hsync = host_sync_stats()
     mem_measure = sample_memory()
+
+    anatomy_summary = None
+    anatomy_warn = None
+    trace_on = os.environ.get("BENCH_TRACE") == "1" or "--trace" in sys.argv
+    if trace_on:
+        # anatomy trace window (telemetry/anatomy.py): a few extra steps
+        # of the SAME compiled program under the profiler, AFTER the
+        # timed loop — profiling overhead must never pollute the
+        # headline number. The ledger joins the trace against the
+        # compiled HLO so collective time lands in named scopes.
+        _phase("trace")
+        import tempfile
+
+        from dinov3_tpu.configs.config import warn_exposed_comm
+        from dinov3_tpu.telemetry import (
+            anatomy_ledger,
+            find_trace_file,
+            ledger_summary,
+            load_trace,
+        )
+        from dinov3_tpu.telemetry.anatomy import round_floats
+
+        tdir = os.environ.get("BENCH_TRACE_DIR") or tempfile.mkdtemp(
+            prefix="bench_trace_", dir="/tmp")
+        n_trace = max(1, min(steps,
+                             int(os.environ.get("BENCH_TRACE_STEPS", "4"))))
+        jax.profiler.start_trace(tdir)
+        try:
+            if plan is not None:
+                for _ in range(n_trace):
+                    state, ring = compiled(state, ring, dbatch, scalars, rng)
+                blocking_fetch(ring.nonfinite_streak)
+            else:
+                for _ in range(n_trace):
+                    state, metrics = compiled(state, dbatch, scalars, rng)
+                blocking_fetch(metrics["total_loss"])
+        finally:
+            jax.profiler.stop_trace()
+        try:
+            led = anatomy_ledger(
+                load_trace(find_trace_file(tdir)),
+                hlo_text=compiled.as_text(), n_steps=n_trace)
+            anatomy_summary = round_floats(ledger_summary(led))
+            anatomy_summary["trace_dir"] = tdir
+            anatomy_warn = warn_exposed_comm(cfg, anatomy_summary)
+            _log(f"anatomy: {anatomy_summary['step_wall_ms']['mean']:.2f} "
+                 f"ms/step wall, exposed-comm "
+                 f"{anatomy_summary['exposed_comm_frac']:.1%}, scopes="
+                 f"{sorted(anatomy_summary['collectives'])}")
+        except Exception as e:  # noqa: BLE001 - anatomy must never kill a run
+            anatomy_summary = {"error": str(e)[:200], "trace_dir": tdir}
     _phase("report")
 
     img_s_chip = B / dt / n
@@ -911,6 +974,13 @@ def main():
         # message-size histogram and issue-site placement
         "buckets": _bucket_summary(setup, coll_census),
     }
+    if anatomy_summary is not None:
+        # measured step anatomy next to the static censuses: per-scope
+        # collective ms with the exposed/overlapped split — the dynamic
+        # twin of collective_census.by_placement
+        rec["anatomy"] = anatomy_summary
+    if anatomy_warn:
+        rec["exposed_comm_warning"] = anatomy_warn
     if census is not None:
         rec["copy_census"] = census
     if coll_census is not None:

@@ -194,6 +194,10 @@ def load_config(
     # capacity x per-entry feature bytes vs the host budget, checked at
     # load so an oversized capacity never waits for the LRU to fill
     warn_serve_cache_memory(cfg)
+    # ... and over the exposed-comm tolerance the anatomy plane gates
+    # on: a tolerance outside (0, 1] makes the measured-overlap
+    # guardrail either always-on noise or dead code
+    warn_exposed_comm(cfg)
     return cfg
 
 
@@ -572,6 +576,83 @@ def warn_telemetry_flush_period(
         f"abort lags by up to a full window (telemetry/ring.py). Lower "
         f"telemetry.flush_every, or set telemetry.async_metrics=false "
         f"for the per-step-fetch oracle."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
+
+
+def anatomy_wished(cfg: ConfigNode) -> bool:
+    """Whether the config ASKS for the step-anatomy trace plane
+    (telemetry/anatomy.py — parse the ``--profile-steps`` /
+    ``bench.py --trace`` profiler window into the per-step ledger).
+    ``telemetry.anatomy``: auto/true (default) = parse + emit; false =
+    the pre-PR-13 raw-trace-only behaviour (kept as the zero-parse
+    oracle, the repo's legacy-path convention)."""
+    t = (cfg.get("telemetry") or {}).get("anatomy", "auto")
+    if isinstance(t, str):
+        return t.lower() in ("auto", "true", "on")
+    return bool(t)
+
+
+def warn_exposed_comm(
+    cfg: ConfigNode, summary: dict | None = None, stacklevel: int = 2,
+) -> str | None:
+    """Warn when a MEASURED anatomy summary shows more exposed
+    (non-overlapped) collective time than ``telemetry.exposed_comm_tol``
+    allows — the axis-labelled guardrail style of
+    ``warn_telemetry_flush_period``, but fired against measurement
+    rather than configuration.
+
+    With ``summary`` (a ``ledger_summary`` dict, from the train loop's
+    profile window or ``bench.py --trace``): compares the measured
+    ``exposed_comm_frac`` — exposed-collective ms over total device-busy
+    ms — against the tolerance, naming the worst-exposed scopes so the
+    warning points at the schedule that failed to hide its comm.
+    Without ``summary`` (the ``load_config`` call): validates that the
+    tolerance itself is a sane fraction in (0, 1]. Returns the message,
+    or None when within tolerance or the anatomy plane is off."""
+    tol = (cfg.get("telemetry") or {}).get("exposed_comm_tol", 0.25)
+    try:
+        tol = float(tol)
+    except (TypeError, ValueError):
+        tol = -1.0
+    if summary is None:
+        if 0.0 < tol <= 1.0:
+            return None
+        msg = (
+            f"exposed-comm tolerance: telemetry.exposed_comm_tol={tol!r} "
+            f"is not a fraction in (0, 1] — the anatomy guardrail "
+            f"compares measured exposed-collective device time against "
+            f"it (telemetry/anatomy.py); set e.g. 0.25."
+        )
+        import warnings
+
+        warnings.warn(msg, stacklevel=stacklevel + 1)
+        return msg
+    if not anatomy_wished(cfg):
+        return None
+    frac = float(summary.get("exposed_comm_frac", 0.0) or 0.0)
+    if frac <= tol:
+        return None
+    scopes = sorted(
+        (summary.get("collectives") or {}).items(),
+        key=lambda kv: -kv[1].get("exposed_ms_per_step", 0.0),
+    )[:3]
+    worst = ", ".join(
+        f"{name}={ent.get('exposed_ms_per_step', 0.0):.2f}ms/step "
+        f"(overlap {ent.get('overlap_frac', 0.0):.0%})"
+        for name, ent in scopes if ent.get("exposed_ms_per_step", 0.0) > 0
+    ) or "no per-scope breakdown"
+    msg = (
+        f"exposed comm: measured exposed-collective fraction "
+        f"{frac:.1%} of device-busy time exceeds "
+        f"telemetry.exposed_comm_tol={tol:g} — the overlap schedule is "
+        f"not hiding its communication (worst scopes: {worst}). On the "
+        f"CPU harness overlap is a structural lower bound "
+        f"(docs/OBSERVABILITY.md); on TPU this means the bucket/stream "
+        f"schedule regressed or the step is genuinely comm-bound."
     )
     import warnings
 

@@ -36,8 +36,27 @@ log-bucketed histograms + the shared nearest-rank quantile helper), and
 ``watchdog.py`` (role-namespaced heartbeats, staleness scan, flush-
 window stall spans) — one span stream and one fetch funnel cover both
 worlds.
+
+The step-anatomy trace plane (ISSUE 13) closes the loop from static
+claims to measured time: ``trace.py`` reads the ``--profile-steps`` /
+``bench.py --trace`` profiler window (trace.json.gz) into per-device
+timelines, and ``anatomy.py`` turns it into a per-step ledger — device
+time by op category, collective time attributed to the repo's named
+scopes via the compiled HLO's ``op_name`` metadata, measured
+exposed/overlapped collective ms (the dynamic twin of the
+``by_placement`` census), and a cross-host fleet report (straggler
+z-scores, input/comm/compute-bound verdict) over the span streams.
 """
 
+from dinov3_tpu.telemetry.anatomy import (
+    anatomy_ledger,
+    build_op_index,
+    categorize,
+    emit_step_anatomy,
+    fleet_report,
+    ledger_summary,
+    load_span_streams,
+)
 from dinov3_tpu.telemetry.hist import LogHistogram, quantile_nearest_rank
 from dinov3_tpu.telemetry.host_sync import blocking_fetch, host_sync_stats
 from dinov3_tpu.telemetry.memory import per_device_state_bytes, sample_memory
@@ -48,6 +67,7 @@ from dinov3_tpu.telemetry.serve_obs import (
     recommended_serve_envelope,
 )
 from dinov3_tpu.telemetry.spans import SERVE_PHASES, SpanTracer, StepTimer
+from dinov3_tpu.telemetry.trace import Trace, TraceEvent, find_trace_file, load_trace
 from dinov3_tpu.telemetry.watchdog import (
     Watchdog,
     heartbeat_path,
@@ -75,4 +95,7 @@ __all__ = [
     "blocking_fetch", "host_sync_stats",
     "per_device_state_bytes", "sample_memory",
     "telemetry_wished",
+    "Trace", "TraceEvent", "find_trace_file", "load_trace",
+    "anatomy_ledger", "build_op_index", "categorize", "emit_step_anatomy",
+    "fleet_report", "ledger_summary", "load_span_streams",
 ]

@@ -1,0 +1,196 @@
+"""jax.profiler trace reader: trace.json.gz -> normalized event timelines.
+
+``jax.profiler.start_trace(dir)`` writes a Chrome-trace JSON
+(``<dir>/plugins/profile/<ts>/*.trace.json.gz``) plus an ``xplane.pb``
+protobuf. This module reads the JSON form into a small normalized
+structure the anatomy ledger (telemetry/anatomy.py) consumes:
+
+- ``find_trace_file(root)``: newest ``*.trace.json.gz`` under a trace
+  output dir (the ``--profile-steps`` / ``bench.py --trace`` layout).
+- ``load_trace(path)`` -> ``Trace``: complete ``ph=="X"`` events with
+  the process/thread name metadata resolved.
+- ``Trace.op_events()``: the device-op subset — events that carry XLA's
+  per-op annotation (``args.hlo_op``/``args.hlo_module``, the XLA:CPU
+  thunk-executor form) or that live on a device process (the TPU/GPU
+  form, where each accelerator is its own trace pid).
+- ``Trace.timelines(events)``: events grouped into per-device
+  timelines. On TPU/GPU each device pid is one timeline (its tids are
+  the compute/DMA streams — genuinely concurrent lanes). On the CPU
+  host backend there is ONE pid (``/host:CPU``) and each *simulated*
+  device's thunks execute on a stable ``tf_XLATfrtCpuClient`` worker
+  thread, so each op-carrying (pid, tid) is one timeline.
+
+The ``xplane.pb`` beside the JSON carries the same events in protobuf
+form; parsing it needs the tensorflow profiler protos, which this repo
+deliberately does not depend on — ``load_trace`` raises a pointed error
+for ``.pb`` paths instead of importing them (the JSON twin is always
+written alongside).
+
+Event times are microseconds (Chrome trace format); the anatomy layer
+converts to ms at the reporting boundary only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+
+# trace pids whose process_name matches one of these substrings are
+# accelerator devices (one pid per chip); everything else is host-side
+_DEVICE_PID_MARKERS = ("/device:", "TPU", "GPU")
+
+# host-thread pools that execute XLA:CPU thunks — used only for the
+# timeline LABEL (attribution itself keys on which tids carry op events)
+_CPU_CLIENT_THREAD = "tf_XLATfrtCpuClient"
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEvent:
+    """One complete (``ph=="X"``) trace event, times in microseconds."""
+
+    name: str
+    pid: int
+    tid: int
+    ts: float
+    dur: float
+    hlo_op: str | None = None
+    hlo_module: str | None = None
+
+    @property
+    def end(self) -> float:
+        return self.ts + self.dur
+
+    @property
+    def op_key(self) -> str:
+        """The HLO-instruction name this event maps to: XLA's own
+        ``hlo_op`` annotation when present, else the event name with
+        any leading ``%`` stripped (TPU traces name device events by
+        instruction directly)."""
+        return (self.hlo_op or self.name).lstrip("%")
+
+
+@dataclasses.dataclass
+class Trace:
+    """All complete events of one trace window + name metadata."""
+
+    events: list
+    process_names: dict  # pid -> name
+    thread_names: dict   # (pid, tid) -> name
+    path: str = ""
+
+    # ---- selection ----
+
+    def device_pids(self) -> set:
+        return {
+            pid for pid, nm in self.process_names.items()
+            if nm and any(m in nm for m in _DEVICE_PID_MARKERS)
+        }
+
+    def op_events(self, module: str | None = None) -> list:
+        """Device-op events: annotated thunk events (XLA:CPU) plus any
+        event on a device pid (TPU/GPU — those pids carry only op
+        events). ``module`` filters by ``hlo_module`` substring when
+        the annotation exists (CPU); device-pid events with no module
+        annotation always pass."""
+        dev = self.device_pids()
+        out = []
+        for e in self.events:
+            if e.hlo_op is None and e.pid not in dev:
+                continue
+            if module and e.hlo_module is not None \
+                    and module not in e.hlo_module:
+                continue
+            out.append(e)
+        return out
+
+    def modules(self) -> dict:
+        """hlo_module -> summed op-event duration (us), for picking the
+        dominant module when the caller does not name one."""
+        acc: dict = {}
+        for e in self.events:
+            if e.hlo_module:
+                acc[e.hlo_module] = acc.get(e.hlo_module, 0.0) + e.dur
+        return acc
+
+    def timelines(self, events: list) -> dict:
+        """Group op events into per-device timelines.
+
+        TPU/GPU: one timeline per device pid (key = process name); the
+        pid's tids are its streams, which genuinely run concurrently —
+        the overlap-measurement lanes. XLA:CPU (single ``/host:CPU``
+        pid): one timeline per op-carrying (pid, tid) — each simulated
+        device's thunks run on a stable client worker thread, and the
+        interleaving OS scheduler means within-timeline overlap is
+        structurally zero (the CPU-harness lower-bound caveat,
+        docs/OBSERVABILITY.md)."""
+        dev = self.device_pids()
+        out: dict = {}
+        for e in events:
+            if e.pid in dev:
+                key = self.process_names.get(e.pid, f"pid{e.pid}")
+            else:
+                tname = self.thread_names.get((e.pid, e.tid), "")
+                base = self.process_names.get(e.pid, f"pid{e.pid}")
+                key = f"{base}/{tname or 't'}{e.tid}"
+            out.setdefault(key, []).append(e)
+        for evs in out.values():
+            evs.sort(key=lambda e: e.ts)
+        return out
+
+
+def find_trace_file(root: str) -> str | None:
+    """Newest ``*.trace.json.gz`` under ``root`` (jax writes
+    ``<root>/plugins/profile/<timestamp>/<host>.trace.json.gz``)."""
+    if os.path.isfile(root):
+        return root
+    paths = glob.glob(os.path.join(root, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        return None
+    return max(paths, key=os.path.getmtime)
+
+
+def load_trace(path: str) -> Trace:
+    """Parse one Chrome-trace JSON (optionally gzipped) into a Trace."""
+    if path.endswith(".pb"):
+        raise ValueError(
+            "xplane.pb parsing needs the tensorflow profiler protos, "
+            "which this repo does not depend on — point the reader at "
+            "the *.trace.json.gz jax writes beside it (same events, "
+            "Chrome trace JSON)."
+        )
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        raw = json.load(f)
+    events: list = []
+    process_names: dict = {}
+    thread_names: dict = {}
+    for e in raw.get("traceEvents", []):
+        name = e.get("name", "")
+        args = e.get("args") or {}
+        if name == "process_name":
+            process_names[e.get("pid")] = args.get("name", "")
+            continue
+        if name == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = args.get("name", "")
+            continue
+        if e.get("ph") != "X" or not name:
+            continue
+        dur = float(e.get("dur", 0.0) or 0.0)
+        if dur <= 0:
+            continue
+        events.append(TraceEvent(
+            name=name,
+            pid=int(e.get("pid", 0)),
+            tid=int(e.get("tid", 0)),
+            ts=float(e.get("ts", 0.0)),
+            dur=dur,
+            hlo_op=args.get("hlo_op"),
+            hlo_module=args.get("hlo_module"),
+        ))
+    events.sort(key=lambda ev: ev.ts)
+    return Trace(events=events, process_names=process_names,
+                 thread_names=thread_names, path=path)

@@ -302,6 +302,9 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     # per-step-fetch oracle behind telemetry.async_metrics=false),
     # phase-span tracer + per-process heartbeat, memory sampling
     tele_cfg = cfg.get("telemetry") or {}
+    from dinov3_tpu.configs.config import anatomy_wished
+
+    anatomy_on = anatomy_wished(cfg)
     plan = setup.telemetry()
     tracer = SpanTracer(
         cfg.train.output_dir, rank=rank,
@@ -443,6 +446,41 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
             # N measured intervals (telemetry/spans.py StepTimer)
             timer.mark(state)
         tracer.profile_step_end(it, state)
+        if prof is not None and it == prof[1] and anatomy_on:
+            # the profiler window just closed: parse the trace into the
+            # per-step anatomy ledger (telemetry/anatomy.py), joined
+            # against the compiled step's HLO so collective time lands
+            # in named scopes. Lowering the already-jitted step again is
+            # one extra (cache-friendly) compile — acceptable inside an
+            # explicit --profile-steps run, and gated off by
+            # telemetry.anatomy=false.
+            from dinov3_tpu.telemetry import emit_step_anatomy
+
+            try:
+                if plan is not None:
+                    hlo = plan.step_fn.lower(
+                        state, ring, batch, setup.scalars(it), rng,
+                    ).compile().as_text()
+                else:
+                    hlo = setup.step_fn.lower(
+                        state, batch, setup.scalars(it), rng,
+                    ).compile().as_text()
+            except Exception:  # pragma: no cover - backend-specific
+                hlo = None
+            try:
+                summary = emit_step_anatomy(
+                    f"{cfg.train.output_dir}/trace", hlo_text=hlo,
+                    n_steps=prof[1] - prof[0] + 1, tracer=tracer,
+                    cfg=cfg, iteration=it)
+                if summary is not None:
+                    logger.info(
+                        "step anatomy: %.2f ms/step wall, exposed-comm "
+                        "%.1f%% of device-busy (ledger: %s/trace/"
+                        "anatomy.json)", summary["step_wall_ms"]["mean"],
+                        100 * summary["exposed_comm_frac"],
+                        cfg.train.output_dir)
+            except Exception:
+                logger.exception("step-anatomy parse failed (trace kept)")
         if "gram" in state.params and should_refresh_gram(
             cfg, it, n_gram_updates
         ):

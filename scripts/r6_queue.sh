@@ -122,6 +122,18 @@
 #         measures what 8x-faster TPU matmul + HBM bandwidth do to
 #         the dequant-fused row (the serve_dequant census category
 #         rides in the record via BENCH_CENSUS=1).
+#   phA   step-anatomy on-chip banking (telemetry/anatomy.py): re-runs
+#         scripts/anatomy_report.py on the real TPU mesh, where each
+#         device is its own trace pid and its streams genuinely run
+#         concurrently — the committed CPU overlap fractions
+#         (ANATOMY_r17.json) are structural lower bounds, and this run
+#         banks the real ones: bucket/zero3 gathers overlapped under
+#         forward compute, the coalesced grad-RS inside the measured
+#         backward interval. perf_gate.py then compares the fresh
+#         record against the CPU baseline (advisory across backends —
+#         step times are not comparable; the TPU record lands in
+#         RESULTS for the next session to commit as the on-chip
+#         baseline).
 # Every bench.py record now embeds the fixed calibration rung
 # ("calib"), so these rows are comparable across sessions.
 #
@@ -356,6 +368,32 @@ if gate_phase 3000 phF_serve_fleet; then
     else
         note "FAIL  phF_serve_fleet rc=$?"
         echo "{\"tag\": \"phF_serve_fleet\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
+    fi
+fi
+
+# phA: step-anatomy on-chip banking. The full anatomy_report (executed
+# update-phase arms, both stream twins, the real-trainer dryrun) on
+# the TPU mesh; the measured-overlap column stops being a lower bound
+# here. perf_gate.py runs advisory against the committed CPU baseline
+# (attribution pins transfer; step times do not compare across
+# backends), and the full record rides RESULTS so the next session can
+# commit it as the on-chip baseline.
+if gate_phase 3000 phA_step_anatomy; then
+    note "start phA_step_anatomy"
+    rm -f /tmp/anatomy_r6.json
+    if timeout 3000 python scripts/anatomy_report.py /tmp/anatomy_r6.json >> "$LOG" 2>&1; then
+        note "done  phA_step_anatomy -> /tmp/anatomy_r6.json"
+        if python scripts/perf_gate.py --baseline ANATOMY_r17.json \
+                --fresh /tmp/anatomy_r6.json >> "$LOG" 2>&1; then
+            note "phA perf_gate: within tolerance of the CPU baseline"
+        else
+            note "phA perf_gate: drift vs the CPU baseline (expected across backends; see $LOG)"
+        fi
+        line=$(python -c "import json; print(json.dumps(json.load(open('/tmp/anatomy_r6.json'))))")
+        echo "{\"tag\": \"phA_step_anatomy\", \"rc\": 0, \"result\": $line}" >> "$RESULTS"
+    else
+        note "FAIL  phA_step_anatomy rc=$?"
+        echo "{\"tag\": \"phA_step_anatomy\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
     fi
 fi
 
