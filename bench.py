@@ -790,6 +790,11 @@ def main():
     bucket_warnings = [str(w.message) for w in _bcaught
                        if "bucket flat axis" in str(w.message)
                        or "bucket size axis" in str(w.message)]
+    # ... and the accumulation tiling guardrail (configs/config.py
+    # warn_accum_batch_tiling: divisibility + per-chip microbatch cliff)
+    accum_warnings = [str(w.message) for w in _bcaught
+                      if "optim.accum_steps axis" in str(w.message)
+                      or "per-chip microbatch" in str(w.message)]
     dbatch = put_batch(batch, setup.batch_shardings)
     rng = jax.random.key(0)
     state = setup.state
@@ -993,6 +998,8 @@ def main():
         rec["zero3_padding_warning"] = "; ".join(zero3_warnings)
     if bucket_warnings:
         rec["bucket_padding_warning"] = "; ".join(bucket_warnings)
+    if accum_warnings:
+        rec["accum_tiling_warning"] = "; ".join(accum_warnings)
     if degraded:
         # distinct reasons can fire for the global- and local-crop
         # batches of the same program — keep them all

@@ -190,6 +190,10 @@ def load_config(
     # ... and over the zero3/scan combination: sharded block weights
     # with no scan loop to stream them through
     warn_zero3_no_stream(cfg)
+    # ... and over microbatched gradient accumulation: accum_steps that
+    # can't tile the batch raise at trace time, and a microbatch can
+    # walk the step back into the sublane cliff one slice at a time
+    warn_accum_batch_tiling(cfg)
     # ... and over the serve feature cache's worst-case footprint:
     # capacity x per-entry feature bytes vs the host budget, checked at
     # load so an oversized capacity never waits for the LRU to fill
@@ -461,16 +465,21 @@ def warn_update_shard_padding(
 
 def bucketed_collectives_wished(cfg: ConfigNode) -> bool:
     """Whether the config ASKS for the bucketed collective engine
-    (before the setup-time data-axis-size > 1 / fused / zero3 checks).
+    (before the setup-time data-axis-size > 1 / fused checks).
 
     ``optim.bucketed_collectives``: auto (default) = on — the coalesced
-    schedule (one reduce-scatter per bucket, one all-gather per bucket,
-    train/fused_update.py make_bucketed_update) is the default whenever
-    the setup-time conditions hold (data-axis product > 1, fused update
-    on, zero3 off — zero3 shards the masters along model dims and
-    supersedes the flat-bucket layout); true = insist (setup raises if
-    the conditions cannot hold); false = the per-leaf schedule, the
-    bitwise test oracle."""
+    schedule is the default whenever the setup-time conditions hold.
+    The mesh picks the arm: flat (non-zero3) meshes bucket the sharded
+    UPDATE phase (one reduce-scatter + one all-gather per ~bucket_mb
+    flat bucket, train/fused_update.py make_bucketed_update; needs the
+    fused sharded update); zero3 meshes select the UNIFIED arm — the
+    non-block subtree gathers of the forward and their transposed grad
+    reduce-scatters coalesce into hierarchy-aware gather buckets
+    (gather_zero3_bucketed: intra-slice RS then inter-slice AG staging
+    on dp×fsdp meshes) while the update stays shard-local zero3 and the
+    block stacks keep the per-block in-scan stream. true = insist
+    (setup raises if the flat arm's conditions cannot hold); false =
+    the per-leaf schedules, the bitwise test oracles for BOTH arms."""
     b = (cfg.get("optim") or {}).get("bucketed_collectives", "auto")
     if isinstance(b, str):
         bl = b.lower()
@@ -478,6 +487,66 @@ def bucketed_collectives_wished(cfg: ConfigNode) -> bool:
             return True
         return bl in ("true", "on", "1")
     return bool(b)
+
+
+def warn_accum_batch_tiling(
+    cfg: ConfigNode, per_chip_batch: int | None = None,
+    threshold: float = 0.2, stacklevel: int = 2, mesh=None,
+) -> list[str]:
+    """Guardrails on microbatched gradient accumulation
+    (``optim.accum_steps``, train/train_step.py split_microbatches) —
+    the axis-labelled style of ``warn_bad_batch_tiling``, fired at
+    config build (``load_config``), at training-setup build
+    (train/setup.py, where the mesh is known) and recorded by
+    ``bench.py``.
+
+    Two failure modes:
+
+    * ``accum_steps`` not dividing the global image batch — the
+      semantic microbatch regroup needs equal image subsets, so
+      ``split_microbatches`` raises at trace time; warn while the
+      config is still editable;
+    * a per-chip microbatch (B/accum_steps) that pads >``threshold`` on
+      the TPU sublane axis — accumulation quietly walking the step into
+      the measured 2.4x ``warn_bad_batch_tiling`` cliff, one microbatch
+      at a time.
+
+    Returns the warning messages ([] when accumulation is off or
+    tiles fine)."""
+    a = int((cfg.get("optim") or {}).get("accum_steps", 1) or 1)
+    if a <= 1:
+        return []
+    b_chip = int(per_chip_batch if per_chip_batch is not None
+                 else cfg.train.batch_size_per_device)
+    if mesh is not None:
+        from dinov3_tpu.parallel.sharding import update_shard_size
+
+        dp = max(1, int(update_shard_size(mesh)))
+    else:
+        dp = max(1, data_parallel_world(cfg))
+    b_global = b_chip * dp
+    msgs = []
+    if b_global % a:
+        msgs.append(
+            f"optim.accum_steps axis: accum_steps={a} does not divide "
+            f"the global image batch B={b_global} "
+            f"(batch_size_per_device={b_chip} x dp={dp}) — the "
+            f"microbatch split (train/train_step.py split_microbatches) "
+            f"will raise at trace time. Pick accum_steps dividing B, or "
+            f"retune the batch."
+        )
+        import warnings
+
+        warnings.warn(msgs[-1], stacklevel=stacklevel + 1)
+        return msgs
+    micro_chip = b_global // a // dp if (b_global // a) % dp == 0 \
+        else -(-(b_global // a) // dp)
+    m = warn_bad_batch_tiling(
+        micro_chip, threshold, stacklevel + 1,
+        axis=f"per-chip microbatch (B/accum_steps={a})")
+    if m:
+        msgs.append(m)
+    return msgs
 
 
 def warn_bucket_padding(

@@ -184,6 +184,7 @@ def bucketed_stream_scan(
     mesh=None,
     prefetch: bool = True,
     consume_fn: Callable | None = None,
+    hierarchical: bool = False,
 ):
     """The BUCKETED forward weight-gather schedule, written explicitly —
     ``streamed_block_scan``'s double-buffer convention lifted from
@@ -207,6 +208,15 @@ def bucketed_stream_scan(
     element into ``x`` (pass-granularity convention of the cost
     scripts — the census prices the collective schedule, not the block
     math).
+
+    ``hierarchical=True`` replaces each flat all-gather with the
+    unified engine's STAGED schedule on a dp×fsdp mesh (inter tier
+    first — the slow links move 1/dp shards — then intra, scopes
+    ``bucket_ag_inter``/``bucket_ag_intra``), followed by an
+    index-order-restoring ``swapaxes``+``reshape`` so the consumed
+    vector is BITWISE the flat gather's device-order concat: the
+    option changes the wire schedule, never the numerics. With one
+    present mesh tier it degrades to the flat gather unchanged.
     """
     if mesh is None:
         from dinov3_tpu.parallel.context import get_current_mesh
@@ -215,9 +225,14 @@ def bucketed_stream_scan(
     from jax.sharding import PartitionSpec as P
 
     from dinov3_tpu.parallel.context import shard_map_compat
-    from dinov3_tpu.parallel.sharding import UPDATE_SHARD_AXES
+    from dinov3_tpu.parallel.sharding import (
+        UPDATE_SHARD_AXES,
+        hierarchy_axes,
+    )
 
     axes = tuple(a for a in UPDATE_SHARD_AXES if a in mesh.shape)
+    inter, intra = hierarchy_axes(mesh)
+    staged = bool(hierarchical and inter and intra)
     n_buckets = int(bucket_shards.shape[0])
     if consume_fn is None:
         def consume_fn(w, x):
@@ -226,6 +241,16 @@ def bucketed_stream_scan(
     def body(shards, x):
         def gather(i, scope):
             s = jax.lax.dynamic_index_in_dim(shards, i, 0, keepdims=False)
+            if staged:
+                # inter-first staged gather, then restore flat device
+                # order: [n_intra, n_inter, cols] -> swap -> reshape
+                # gives exactly the flat tiled gather's concat
+                with jax.named_scope("bucket_ag_inter"):
+                    g = jax.lax.all_gather(s, inter, tiled=False)
+                with jax.named_scope("bucket_ag_intra"):
+                    g = jax.lax.all_gather(g, intra, tiled=False)
+                with jax.named_scope(scope):
+                    return jnp.swapaxes(g, 0, 1).reshape(-1)
             with jax.named_scope(scope):
                 return jax.lax.all_gather(s, axes, tiled=True)
 

@@ -423,6 +423,105 @@ def zero3_materialize_tree(tree: Any, mesh: Mesh | None = None) -> Any:
         return jax.tree.map(lambda x: constrain_replicated(x, mesh), tree)
 
 
+# ---------------- hierarchy-aware bucketed gathers (the unified
+# zero3 x bucketed-collectives engine, train/fused_update.py
+# make_zero3_bucket_plan + ssl_meta_arch._zero3_gather_params) --------
+#
+# On a dp x fsdp mesh the data axes split into two bandwidth tiers:
+# fsdp is the ICI-innermost (fast) tier, the remaining >1 data axes
+# (dcn_data / data) the slow inter-slice tier. The bandwidth-optimal
+# hierarchical all-gather (PAPERS.md 2408.13356) gathers over the SLOW
+# tier first — each device moves its small 1/dp shard across the slow
+# links once, then the fast tier broadcasts the assembled 1/n_intra
+# segments — and its transpose reduce-scatters over the FAST tier
+# first, shrinking the cotangent n_intra-fold before it ever touches a
+# slow link. The staging below expresses both orders as sharding
+# constraints on a [n_inter, n_intra, cols] bucket view, placed through
+# the mesh axes by GSPMD (2105.04663) exactly like every other
+# collective in this repo.
+
+
+def hierarchy_axes(mesh: Mesh) -> tuple[tuple[str, ...], tuple[str, ...]]:
+    """Split the PRESENT (>1) zero3 data axes into the two bandwidth
+    tiers: ``(inter_axes, intra_axes)``.
+
+    ``intra`` is the innermost present axis (fsdp on dp x fsdp meshes —
+    the ICI tier mesh construction places innermost/fastest); ``inter``
+    is every other present data axis. A single-tier mesh degrades to
+    ``((), (axis,))`` — the staged schedule then collapses to one
+    gather/scatter stage; an all-replicated mesh returns ``((), ())``.
+    """
+    present = tuple(
+        a for a in ZERO3_AXES if int(mesh.shape.get(a, 1)) > 1)
+    if not present:
+        return (), ()
+    return present[:-1], present[-1:]
+
+
+def hier_bucket_spec(mesh: Mesh):
+    """The fully-sharded ``PartitionSpec`` of one gather bucket in its
+    ``[n_inter, n_intra, cols]`` view: dim 0 over the inter tier, dim 1
+    over the intra tier (empty tiers replicate their dim)."""
+    inter, intra = hierarchy_axes(mesh)
+    return P(inter or None, intra or None, None)
+
+
+def _constrain3(x: jax.Array, mesh: Mesh, spec: P, scope: str) -> jax.Array:
+    with jax.named_scope(scope):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def hier_gather_bucket(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Replicate one flat gather bucket with the hierarchy-aware
+    two-stage schedule, differentiable with direction-true scope names.
+
+    ``x``: ``[n_inter, n_intra, cols]`` sharded per ``hier_bucket_spec``
+    (device ``(i_inter, i_intra)`` holds element ``[i_inter, i_intra,
+    :]`` — its own shard, so the pack that built the bucket was
+    shard-local). Forward constrains dim 0 replicated under
+    ``bucket_ag_inter`` (the slow tier moves 1/dp-sized shards), then
+    dim 1 replicated under ``bucket_ag_intra`` (the fast tier
+    broadcasts the assembled segments). Pure data movement — values are
+    bitwise whatever the staging.
+
+    The backward is a hand-written ``custom_vjp``, NOT the autodiff
+    transpose: a transposed sharding constraint keeps the FORWARD
+    scope in its ``op_name`` (``transpose(bucket_ag_inter)``), so the
+    census could never tell the grad reduce-scatters from the gathers.
+    The bwd applies the reverse staging to the cotangent — intra tier
+    first (``bucket_rs_intra``: the fast links do the n_intra-fold
+    volume reduction), then inter (``bucket_rs_inter``) — and GSPMD
+    materializes the partial-sum reductions as reduce-scatters at
+    exactly these constraint points.
+    """
+    inter, intra = hierarchy_axes(mesh)
+    if not inter and not intra:
+        return x
+    sharded = P(inter or None, intra or None, None)
+    half = P(None, intra or None, None)
+
+    def _primal(b):
+        if inter:
+            b = _constrain3(b, mesh, half, "bucket_ag_inter")
+        return _constrain3(b, mesh, P(None, None, None), "bucket_ag_intra")
+
+    @jax.custom_vjp
+    def gather(b):
+        return _primal(b)
+
+    def fwd(b):
+        return _primal(b), None
+
+    def bwd(_, ct):
+        ct = _constrain3(ct, mesh, half, "bucket_rs_intra")
+        if inter:
+            ct = _constrain3(ct, mesh, sharded, "bucket_rs_inter")
+        return (ct,)
+
+    gather.defvjp(fwd, bwd)
+    return gather(x)
+
+
 def make_sharded_init(
     boxed_init_fn: Callable,
     mesh: Mesh,

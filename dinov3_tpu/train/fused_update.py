@@ -1435,3 +1435,442 @@ def make_bucketed_update_schedule(
         return new_params, new_teacher, new_opt_state, norms
 
     return schedule
+
+
+# ---------------- unified engine: zero3 gather buckets ----------------
+#
+# The bucketed engine above coalesces the UPDATE phase of the pure-dp
+# flat layout. Under zero3 there is no flat update phase to bucket —
+# the update is shard-local over model-shaped 1/dp leaves — but the
+# per-step collective schedule has its own per-leaf tail: the NON-block
+# subtree gathers of ssl_meta_arch._zero3_gather_params (heads, patch
+# embed, norms, final layers — one all-gather per leaf, one transposed
+# reduce-scatter per grad leaf; the block stacks stream per block
+# inside the scan BY DESIGN and are excluded here). The zero3 gather
+# buckets below coalesce exactly that tail: non-block leaves grouped by
+# their ZeRO-3 leaf spec (top-level submodel, dtype, sharded dim) and
+# packed into flat buckets whose gather is ONE hierarchy-aware staged
+# all-gather per bucket (parallel/sharding.py hier_gather_bucket) and
+# whose grad sync is ONE staged reduce-scatter per bucket — the PR-9
+# shard-interleave lifted onto the zero3 layout.
+#
+# The bucket view is [n_inter, n_intra, cols]: element [i, j, :] is,
+# member by member in tree order, the flat form of the shard device
+# (i, j) already HOLDS under the leaf's zero3 spec (the sharded dim
+# reshaped to (dp, d/dp) and moved to the front — d % dp == 0 by
+# zero3_leaf_spec construction, so there is NO padding, unlike the flat
+# engine's padded-leaf form). Packing is therefore shard-local data
+# movement, the bucket reduce-scatter computes segment for segment the
+# identical sums the per-leaf schedule computes, and member extraction
+# from a gathered bucket is a column slice + inverse reshape. The
+# per-leaf zero3 gather stays the oracle behind
+# optim.bucketed_collectives=false.
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero3BucketMember:
+    """One non-block leaf's segment inside a zero3 gather bucket."""
+
+    index: int       # leaf index in the gathered tree's flatten order
+    path: str        # jax.tree_util.keystr of the leaf (diagnostics)
+    shape: tuple     # original (model) leaf shape
+    shard_dim: int   # the dim zero3_leaf_spec sharded over the data axes
+    size: int        # element count
+    cols: int        # size // dp — the member's column width
+    offset: int      # column start inside the bucket
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero3Bucket:
+    """One coalesced zero3 gather bucket (layout comment above)."""
+
+    name: str
+    group: str       # top-level submodel key
+    dtype: Any       # numpy dtype of every member
+    shard_dim: int   # shared zero3 sharded-dim index of every member
+    members: tuple   # tuple[Zero3BucketMember, ...]
+    cols: int        # total column count (sum of member cols)
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero3GatherPlan:
+    """The non-block leaf -> gather bucket assignment for ONE param
+    tree shape under the unified engine.
+
+    Built per tree (student and frozen trees differ) from paths +
+    shapes/dtypes only, so it works on tracers inside the step trace as
+    well as on the abstract params at setup (train/setup.py builds the
+    student plan once for the guardrail/census/tests; the step rebuilds
+    it host-side per trace — deterministic, metadata-only).
+
+    Leaf classes:
+    * ``streamed`` — block-stack subtrees (``blocks``/``blocks_i``/
+      ``pipeline``): untouched, their weights gather per block inside
+      the scan;
+    * bucket members — leaves with a zero3-dividing dim, grouped by
+      (top-level submodel, dtype, shard_dim) — submodel and dtype for
+      the same reasons as ``make_bucket_plan``, shard_dim because it IS
+      the zero3 leaf spec under the gather's model-parallel-free gate
+      (every other spec entry is None there) and members of one bucket
+      must share the pack reshape's alignment;
+    * ``perleaf`` — leaves with NO dividing dim: replicated under zero3
+      anyway, gathered per leaf exactly as the oracle does.
+    """
+
+    buckets: tuple       # tuple[Zero3Bucket, ...]
+    streamed: tuple      # leaf indices left to the in-scan block stream
+    perleaf: tuple       # leaf indices gathered per leaf (no dividing dim)
+    n_inter: int
+    n_intra: int
+    n_leaves: int
+    target_bytes: int
+
+    @property
+    def dp(self) -> int:
+        return self.n_inter * self.n_intra
+
+    @property
+    def names(self):
+        return [b.name for b in self.buckets]
+
+    def stats(self):
+        """Per-bucket accounting rows (guardrail/bench/census style)."""
+        return [
+            {
+                "name": b.name,
+                "group": b.group,
+                "dtype": str(jnp.dtype(b.dtype)),
+                "shard_dim": int(b.shard_dim),
+                "n_leaves": len(b.members),
+                "elems": int(b.cols) * self.dp,
+                "bytes": int(b.cols) * self.dp
+                * jnp.dtype(b.dtype).itemsize,
+            }
+            for b in self.buckets
+        ]
+
+
+def zero3_streamed_path(path) -> bool:
+    """Whether a leaf path belongs to a block-stack subtree the in-scan
+    zero3 weight stream owns (the skip rule of
+    ``ssl_meta_arch._zero3_gather_params``, shared so the plan and the
+    per-leaf oracle walk can never disagree about which leaves the
+    gather phase covers)."""
+    for k in path:
+        name = getattr(k, "key", None)
+        if not isinstance(name, str):
+            continue
+        if name == "blocks" or name.startswith("blocks_") \
+                or name == "pipeline":
+            return True
+    return False
+
+
+def make_zero3_bucket_plan(
+    tree: Any,
+    mesh,
+    target_bytes: int = 128 * 2 ** 20,
+) -> Zero3GatherPlan:
+    """Build the non-block leaf -> gather bucket assignment (see
+    ``Zero3GatherPlan``). ``tree``: a zero3-sharded param tree (abstract
+    or concrete — only paths/shapes/dtypes are read)."""
+    import jax.tree_util as jtu
+
+    from dinov3_tpu.parallel.sharding import (
+        hierarchy_axes,
+        zero3_leaf_spec,
+    )
+
+    inter, intra = hierarchy_axes(mesh)
+    n_inter = 1
+    for a in inter:
+        n_inter *= int(mesh.shape[a])
+    n_intra = 1
+    for a in intra:
+        n_intra *= int(mesh.shape[a])
+    dp = n_inter * n_intra
+
+    flat, _ = jtu.tree_flatten_with_path(tree)
+    streamed, perleaf = [], []
+    groups: dict = {}
+
+    def top_key(path):
+        k = path[0]
+        return str(getattr(k, "key", getattr(k, "idx", k)))
+
+    for i, (path, leaf) in enumerate(flat):
+        if zero3_streamed_path(path):
+            streamed.append(i)
+            continue
+        shape = tuple(leaf.shape)
+        spec = (zero3_leaf_spec(shape, (None,) * len(shape), mesh)
+                if dp > 1 else None)
+        if spec is None:
+            perleaf.append(i)
+            continue
+        shard_dim = next(j for j, s in enumerate(spec) if s is not None)
+        n = leaf_size(leaf)
+        key = (top_key(path), jnp.dtype(leaf.dtype).str, shard_dim)
+        groups.setdefault(key, []).append(Zero3BucketMember(
+            index=i, path=jtu.keystr(path), shape=shape,
+            shard_dim=shard_dim, size=n, cols=n // dp, offset=0,
+        ))
+
+    buckets = []
+    for (group, dtype_str, shard_dim), members in groups.items():
+        itemsize = jnp.dtype(dtype_str).itemsize
+        # greedy fill to the byte target (make_bucket_plan's rule:
+        # oversized leaves become single-member buckets, never split)
+        runs, run, run_bytes = [], [], 0
+        for m in members:
+            nbytes = m.size * itemsize
+            if run and run_bytes + nbytes > target_bytes:
+                runs.append(run)
+                run, run_bytes = [], 0
+            run.append(m)
+            run_bytes += nbytes
+        if run:
+            runs.append(run)
+        # straggler rebalance, same 1/8-of-target rule as the flat plan
+        if len(runs) >= 2 and sum(
+                m.size for m in runs[-1]) * itemsize < target_bytes // 8:
+            runs[-2].extend(runs.pop())
+        for run in runs:
+            off, placed = 0, []
+            for m in run:
+                placed.append(dataclasses.replace(m, offset=off))
+                off += m.cols
+            buckets.append(Zero3Bucket(
+                name="", group=group, dtype=jnp.dtype(dtype_str),
+                shard_dim=shard_dim, members=tuple(placed), cols=off,
+            ))
+
+    buckets.sort(key=lambda b: b.members[0].index)
+    named = tuple(
+        dataclasses.replace(b, name=f"z{i:03d}_{b.group}")
+        for i, b in enumerate(buckets)
+    )
+    return Zero3GatherPlan(
+        buckets=named, streamed=tuple(streamed), perleaf=tuple(perleaf),
+        n_inter=n_inter, n_intra=n_intra, n_leaves=len(flat),
+        target_bytes=int(target_bytes),
+    )
+
+
+def _zero3_member_rows(leaf, member: Zero3BucketMember,
+                       n_inter: int, n_intra: int):
+    """Model-shaped zero3-sharded leaf -> its [n_inter, n_intra, cols]
+    row view: the sharded dim splits into (dp, d/dp), the dp axis moves
+    to the front and factors into the two tiers, the rest flattens
+    row-major — so element [i, j, :] is EXACTLY device (i, j)'s shard
+    flattened in original axis order (shard-local under GSPMD)."""
+    dp = n_inter * n_intra
+    j, shape = member.shard_dim, member.shape
+    x = leaf.reshape(shape[:j] + (dp, shape[j] // dp) + shape[j + 1:])
+    x = jnp.moveaxis(x, j, 0)
+    return x.reshape(n_inter, n_intra, -1)
+
+
+def _zero3_member_unrows(rows, member: Zero3BucketMember):
+    """Inverse of ``_zero3_member_rows`` on a REPLICATED (gathered)
+    [n_inter, n_intra, cols] member segment -> the model-shaped leaf."""
+    j, shape = member.shard_dim, member.shape
+    dp = rows.shape[0] * rows.shape[1]
+    x = rows.reshape((dp,) + shape[:j] + (shape[j] // dp,) + shape[j + 1:])
+    x = jnp.moveaxis(x, 0, j)
+    return x.reshape(shape)
+
+
+def gather_zero3_bucketed(tree: Any, mesh,
+                          target_bytes: int = 128 * 2 ** 20,
+                          plan: Zero3GatherPlan | None = None) -> Any:
+    """The unified engine's replacement for the per-leaf non-block
+    zero3 gather: pack the shardable non-block leaves into
+    [n_inter, n_intra, cols] buckets (scope ``bucket_pack`` — pure
+    shard-local movement), replicate each with ONE hierarchy-aware
+    staged all-gather (``hier_gather_bucket``: scopes
+    ``bucket_ag_inter``/``bucket_ag_intra``, whose hand-written
+    backward is the staged per-bucket grad reduce-scatter under
+    ``bucket_rs_intra``/``bucket_rs_inter``), and unpack to model
+    shapes (scope ``bucket_unpack``). Streamed (block-stack) leaves
+    pass through untouched; leaves with no dividing dim gather per leaf
+    under ``zero3_gather`` exactly as the oracle walk does."""
+    import jax.tree_util as jtu
+
+    from dinov3_tpu.parallel.sharding import (
+        constrain_replicated,
+        hier_bucket_spec,
+        hier_gather_bucket,
+    )
+
+    if plan is None:
+        plan = make_zero3_bucket_plan(tree, mesh, target_bytes)
+    flat, treedef = jtu.tree_flatten_with_path(tree)
+    if len(flat) != plan.n_leaves:
+        raise ValueError(
+            f"zero3 gather plan built for {plan.n_leaves} leaves, got a "
+            f"tree with {len(flat)}"
+        )
+    leaves = [leaf for _, leaf in flat]
+    out = list(leaves)
+
+    spec = hier_bucket_spec(mesh)
+    for b in plan.buckets:
+        with jax.named_scope("bucket_pack"):
+            parts = [
+                _zero3_member_rows(leaves[m.index], m,
+                                   plan.n_inter, plan.n_intra)
+                for m in b.members
+            ]
+            rows = (parts[0] if len(parts) == 1
+                    else jnp.concatenate(parts, axis=-1))
+            # pin the packed bucket to its tiered layout so GSPMD sees
+            # the pack as shard-local movement, not a resharding
+            rows = jax.lax.with_sharding_constraint(
+                rows, jax.sharding.NamedSharding(mesh, spec))
+        full = hier_gather_bucket(rows, mesh)
+        with jax.named_scope("bucket_unpack"):
+            for m in b.members:
+                seg = full[:, :, m.offset:m.offset + m.cols]
+                out[m.index] = _zero3_member_unrows(seg, m)
+
+    if plan.perleaf:
+        with jax.named_scope("zero3_gather"):
+            for i in plan.perleaf:
+                out[i] = constrain_replicated(leaves[i], mesh)
+
+    return jtu.tree_unflatten(treedef, out)
+
+
+def make_zero3_gather_schedule(
+    plan: Zero3GatherPlan, mesh, bucketed: bool = True,
+) -> Callable:
+    """The unified gather phase with EXPLICIT collectives — the
+    ``make_bucketed_update_schedule`` convention applied to the zero3
+    non-block gather, compiled by scripts/cost_unified.py for the
+    committed census (this container's XLA:CPU lowers the GSPMD
+    engine's reduce-scatters in the pre-rewrite all-reduce+slice form,
+    so the schedule twin is the committed proof of the post-rewrite
+    collective set, exactly as for the flat bucketed engine).
+
+    Returns ``gather(tree) -> gathered tree`` as ONE shard_map island
+    over the zero3-sharded non-block subtree (``plan`` must have no
+    streamed leaves — the in-scan block stream is censused by
+    scripts/cost_zero3.py, not here). ``bucketed=True`` packs each
+    bucket's member shards into the flat row the device already holds
+    (shard-local ``reshape``+concat, scope ``bucket_pack``) and
+    replicates it with the STAGED schedule: ``all_gather`` over the
+    inter tier first (small shards cross the slow tier), then the intra
+    tier, ``swapaxes`` restoring device order — scopes
+    ``bucket_ag_inter``/``bucket_ag_intra`` — with a hand-written
+    transpose issuing the staged grad reduce-scatter ``psum_scatter``
+    intra-first/inter-second (scopes ``bucket_rs_intra``/
+    ``bucket_rs_inter``): ONE RS per bucket per backward, tier for
+    tier the mirror of the forward gather. ``bucketed=False`` is the
+    per-leaf oracle: one ``all_gather`` per leaf along its zero3 dim
+    (scope ``zero3_gather``), whose built-in transpose is one
+    ``psum_scatter`` per grad leaf — the collective set the bucket arm
+    collapses.
+    """
+    import jax.tree_util as jtu
+
+    from dinov3_tpu.parallel.context import shard_map_compat
+    from dinov3_tpu.parallel.sharding import (
+        hierarchy_axes,
+        update_shard_size,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    if plan.streamed:
+        raise ValueError(
+            f"gather schedule twin covers the NON-block subtree; plan "
+            f"has {len(plan.streamed)} streamed leaves — pass the tree "
+            f"with the block stacks dropped"
+        )
+    if update_shard_size(mesh) != plan.dp:
+        raise ValueError(
+            f"plan built at dp={plan.dp}, mesh has "
+            f"dp={update_shard_size(mesh)}")
+    inter, intra = hierarchy_axes(mesh)
+    axes = inter + intra
+    n_inter, n_intra = plan.n_inter, plan.n_intra
+
+    def _staged_ag(row):
+        # [cols] shard row -> replicated [n_inter, n_intra, cols]
+        with jax.named_scope("bucket_ag_inter"):
+            g = (jax.lax.all_gather(row, inter, tiled=False)
+                 if inter else row[None])
+        with jax.named_scope("bucket_ag_intra"):
+            g = jax.lax.all_gather(g, intra, tiled=False)
+        return jnp.swapaxes(g, 0, 1)
+
+    @jax.custom_vjp
+    def staged_gather(row):
+        return _staged_ag(row)
+
+    def _fwd(row):
+        return _staged_ag(row), None
+
+    def _bwd(_, ct):
+        # replicated [n_inter, n_intra, cols] cotangent -> this
+        # device's [cols] grad shard: tier-for-tier mirror of the
+        # forward, intra reduce-scatter first
+        with jax.named_scope("bucket_rs_intra"):
+            r = jax.lax.psum_scatter(
+                ct, intra, scatter_dimension=1, tiled=False)
+        with jax.named_scope("bucket_rs_inter"):
+            r = (jax.lax.psum_scatter(
+                r, inter, scatter_dimension=0, tiled=False)
+                if inter else r[0])
+        return (r,)
+
+    staged_gather.defvjp(_fwd, _bwd)
+
+    shard_dims = {m.index: m.shard_dim
+                  for b in plan.buckets for m in b.members}
+
+    def body(*leaves):
+        out = list(leaves)
+        for b in plan.buckets:
+            if bucketed:
+                with jax.named_scope("bucket_pack"):
+                    # the local shard flattened in axis order IS the
+                    # member's bucket-row segment (layout comment on
+                    # the unified engine above) — pack is a reshape
+                    parts = [leaves[m.index].reshape(-1)
+                             for m in b.members]
+                    row = (parts[0] if len(parts) == 1
+                           else jnp.concatenate(parts))
+                full3 = staged_gather(row)
+                with jax.named_scope("bucket_unpack"):
+                    for m in b.members:
+                        seg = full3[:, :, m.offset:m.offset + m.cols]
+                        out[m.index] = _zero3_member_unrows(seg, m)
+            else:
+                with jax.named_scope("zero3_gather"):
+                    for m in b.members:
+                        out[m.index] = jax.lax.all_gather(
+                            leaves[m.index], axes,
+                            axis=m.shard_dim, tiled=True)
+        return tuple(out)
+
+    def gather(tree):
+        flat, treedef = jtu.tree_flatten_with_path(tree)
+        if len(flat) != plan.n_leaves:
+            raise ValueError(
+                f"plan built for {plan.n_leaves} leaves, got "
+                f"{len(flat)}")
+        leaves = [leaf for _, leaf in flat]
+        in_specs = tuple(
+            P(*((None,) * shard_dims[i] + (axes,)))
+            if i in shard_dims else P()
+            for i in range(len(leaves))
+        )
+        out_specs = tuple(P() for _ in leaves)
+        out = shard_map_compat(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )(*leaves)
+        return jtu.tree_unflatten(treedef, list(out))
+
+    return gather

@@ -96,6 +96,11 @@ class TrainSetup:
     zero3: bool = False  # ZeRO-3 weight-streaming layout (masters sharded)
     bucketed: bool = False  # coalesced bucket form of the sharded engine
     bucket_plan: Any = None  # the leaf->bucket assignment (BucketPlan)
+    # unified engine (zero3 × buckets): the non-block zero3 gathers and
+    # their grad reduce-scatters run as hierarchy-aware flat buckets
+    zero3_buckets: bool = False
+    zero3_bucket_plan: Any = None  # Zero3GatherPlan (student tree)
+    accum_steps: int = 1  # microbatched gradient accumulation
     # lazy TelemetryPlan builder; None = telemetry.async_metrics=false
     # (the per-step-fetch oracle path is then the only metrics path)
     telemetry_builder: Callable | None = None
@@ -119,6 +124,29 @@ class TrainSetup:
 
 
 def build_train_setup(
+    cfg: ConfigNode,
+    example_batch: dict,
+    rng: jax.Array | None = None,
+    devices=None,
+    mesh=None,
+    init_state: bool = True,
+) -> TrainSetup:
+    """See ``_build_train_setup``; this wrapper restores the ambient
+    current-mesh when setup raises (the config-validation raises fire
+    AFTER the mesh context is installed — without the restore a failed
+    setup leaves later traces resolving against the wrong mesh)."""
+    from dinov3_tpu.parallel.context import get_current_mesh, set_current_mesh
+
+    prev = get_current_mesh()
+    try:
+        return _build_train_setup(
+            cfg, example_batch, rng, devices, mesh, init_state)
+    except BaseException:
+        set_current_mesh(prev)
+        raise
+
+
+def _build_train_setup(
     cfg: ConfigNode,
     example_batch: dict,
     rng: jax.Array | None = None,
@@ -200,11 +228,18 @@ def build_train_setup(
             "1/dp shards); set sharded_update=false or re-enable "
             "fused_update"
         )
-    # Bucketed collective engine (optim.bucketed_collectives, auto = on):
-    # when the sharded update engages, coalesce its per-leaf schedule
-    # (one RS + two AGs per leaf) into one RS/AG per ~bucket_mb flat
-    # bucket (train/fused_update.py make_bucketed_update). The per-leaf
-    # engine stays the bitwise oracle behind =false.
+    # Bucketed collective engine (optim.bucketed_collectives, auto = on).
+    # Two arms share the flag:
+    # * flat meshes (no zero3): when the sharded update engages, its
+    #   per-leaf schedule (one RS + two AGs per leaf) coalesces into one
+    #   RS/AG per ~bucket_mb flat bucket (make_bucketed_update);
+    # * zero3 meshes: the UNIFIED arm — the non-block subtree gathers of
+    #   the forward (and their transposed grad reduce-scatters) coalesce
+    #   into hierarchy-aware gather buckets (gather_zero3_bucketed;
+    #   staged intra/inter collectives on dp×fsdp meshes), while the
+    #   update itself stays shard-local zero3 and the block stacks keep
+    #   their per-block in-scan stream.
+    # The per-leaf engines stay the bitwise oracles behind =false.
     from dinov3_tpu.configs.config import bucketed_collectives_wished
 
     bucketed_raw = (cfg.get("optim") or {}).get(
@@ -212,33 +247,44 @@ def build_train_setup(
     bucketed_explicit = (not isinstance(bucketed_raw, str)
                          or bucketed_raw.lower() != "auto")
     bucketed_wished = bucketed_collectives_wished(cfg)
-    if bucketed_explicit and bucketed_wished:
-        if use_zero3:
-            raise ValueError(
-                "optim.bucketed_collectives=true conflicts with "
-                "parallel.zero3: zero3 shards the masters along model "
-                "dims and runs the update shard-local — there is no "
-                "flat update-phase schedule to bucket. Set "
-                "optim.bucketed_collectives=auto (it yields to zero3) "
-                "or parallel.zero3=false."
-            )
+    if bucketed_explicit and bucketed_wished and not use_zero3:
+        # (under zero3 the flag selects the unified gather-bucket arm —
+        # no update-engine requirements there, so no raises)
         if not fused_wished:
             raise ValueError(
                 "optim.bucketed_collectives=true requires "
-                "optim.fused_update=true (the bucketed engine is the "
-                "fused single-pass math over bucket shards); re-enable "
-                "fused_update or set bucketed_collectives=false"
+                "optim.fused_update=true on non-zero3 meshes (the flat "
+                "bucketed engine is the fused single-pass math over "
+                "bucket shards; only the unified zero3 gather-bucket "
+                "arm — parallel.zero3 on an fsdp>1 mesh — works without "
+                "it); re-enable fused_update or set "
+                "bucketed_collectives=false"
             )
         if sharded_explicit and not bool(sharded_wished):
             raise ValueError(
                 "optim.bucketed_collectives=true requires the sharded "
-                "update path (optim.sharded_update=auto/true): the "
-                "buckets ARE the coalesced form of its flat "
-                "update_shard layout. Unset sharded_update=false or "
-                "set bucketed_collectives=false."
+                "update path (optim.sharded_update=auto/true) on "
+                "non-zero3 meshes: the flat buckets ARE the coalesced "
+                "form of its update_shard layout (zero3 meshes instead "
+                "select the unified gather-bucket arm, which has no "
+                "such requirement). Unset sharded_update=false or set "
+                "bucketed_collectives=false."
             )
     use_bucketed = (bucketed_wished and use_sharded)
     use_sharded = use_sharded and not use_bucketed
+    # the unified arm: zero3 layout + gather buckets. meta computed the
+    # same wish from cfg alone; setup has the final word (dp gate).
+    use_zero3_buckets = bool(use_zero3 and bucketed_wished
+                             and meta.zero3_gather)
+    meta.zero3_buckets = use_zero3_buckets
+    zero3_bucket_plan = None
+    if use_zero3_buckets:
+        from dinov3_tpu.train.fused_update import make_zero3_bucket_plan
+
+        zero3_bucket_plan = make_zero3_bucket_plan(
+            abstract_params["student"], mesh,
+            target_bytes=meta.zero3_bucket_bytes,
+        )
     bucket_plan = None
     if fused_wished:
         from dinov3_tpu.train.fused_update import (
@@ -396,11 +442,21 @@ def build_train_setup(
         state = nn.meta.unbox(abstract)
 
     b_shardings = batch_specs(mesh, example_batch)
+    # microbatched gradient accumulation (optim.accum_steps): the step
+    # scans the fwd/bwd over accum_steps microbatches with ONE bucketed
+    # grad-RS per optimizer step (train_step.py). Tiling guardrail fires
+    # here too (load_config already warned once at build).
+    accum_steps = int((cfg.get("optim") or {}).get("accum_steps", 1) or 1)
+    if accum_steps > 1:
+        from dinov3_tpu.configs.config import warn_accum_batch_tiling
+
+        warn_accum_batch_tiling(cfg, mesh=mesh)
     raw_step = make_train_step(
         meta, optimizer,
         clip_grad=cfg.optim.clip_grad,
         monitor_grad_norm=cfg.train.monitor_gradient_norm,
         fused_update=fused,
+        accum_steps=accum_steps,
     )
     rep = replicated(mesh)
     scalar_shardings = {"teacher_temp": rep, "momentum": rep}
@@ -465,6 +521,9 @@ def build_train_setup(
         step_fn=step_fn, batch_shardings=b_shardings, fused_update=fused,
         sharded_update=use_sharded, zero3=use_zero3,
         bucketed=use_bucketed, bucket_plan=bucket_plan,
+        zero3_buckets=use_zero3_buckets,
+        zero3_bucket_plan=zero3_bucket_plan,
+        accum_steps=accum_steps,
         telemetry_builder=telemetry_builder,
     )
 

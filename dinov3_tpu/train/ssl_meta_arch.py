@@ -215,6 +215,18 @@ class SSLMetaArch:
         from dinov3_tpu.configs.config import zero3_stream_wished
 
         self.zero3_gather = zero3_stream_wished(cfg)
+        # Unified engine (train/setup.py decides the final arm and syncs
+        # this flag): coalesce the non-block zero3 gathers + their grad
+        # reduce-scatters into hierarchy-aware flat buckets
+        # (train/fused_update.py gather_zero3_bucketed). The per-leaf
+        # walk below stays the =false oracle.
+        from dinov3_tpu.configs.config import bucketed_collectives_wished
+
+        self.zero3_buckets = (
+            self.zero3_gather and bucketed_collectives_wished(cfg)
+        )
+        self.zero3_bucket_bytes = int(
+            (cfg.get("optim") or {}).get("bucket_mb", 128) or 128) * 2 ** 20
         self.gram_enabled = bool(cfg.gram.use_loss)
         self.gram_uses_ema_teacher = bool(cfg.gram.ema_teacher)
         # per-iteration loss-weight ramps (host numpy; moved in-graph by the
@@ -807,21 +819,26 @@ class SSLMetaArch:
         rngs=None,
         rng_plan=None,
         update_centers=True,
+        gather_params=True,
     ):
         """Loss for one batch. ``frozen_params`` = {"teacher": ..,
         ["gram": ..]} under stop_gradient; gradients flow only through
         ``student_params``. Student randomness comes from EITHER ``rngs``
         (legacy fold_in streams) or ``rng_plan`` (the step-wide plan,
         ``build_rng_plan``); the teacher/gram passes are deterministic
-        and consume neither."""
+        and consume neither. ``gather_params=False`` skips the zero3
+        gathers — the microbatched accumulation path hoists them outside
+        its scan (one gather + one grad-RS per OPTIMIZER step, not per
+        microbatch) and passes already-replicated trees."""
         frozen = jax.lax.stop_gradient(frozen_params)
         # ZeRO-3: replicate the non-streamed master subtrees for this
         # step's compute (heads/patch-embed/norms; the block stacks stay
         # sharded and gather per block inside the scan). Differentiated
         # for the student — the constraint's transpose is the grad
         # reduce-scatter back to the sharded master layout.
-        student_params = self._zero3_gather_params(student_params)
-        frozen = self._zero3_gather_params(frozen)
+        if gather_params:
+            student_params = self._zero3_gather_params(student_params)
+            frozen = self._zero3_gather_params(frozen)
         teacher_global, new_state = self.get_teacher_output(
             frozen["teacher"], batch, teacher_temp, state, update_centers,
         )
@@ -846,15 +863,30 @@ class SSLMetaArch:
         block inside the stack. No-op when zero3 gathering is off or no
         mesh is active, and shape-preserving always (zero3 never changes
         leaf shapes), so both engine arms share this code path
-        structurally."""
+        structurally.
+
+        Two arms: the unified engine (``self.zero3_buckets``) coalesces
+        the shardable leaves into hierarchy-aware flat buckets — one
+        staged all-gather per bucket, one staged grad reduce-scatter per
+        bucket in the transpose (``gather_zero3_bucketed``); the
+        per-leaf walk below is the ``optim.bucketed_collectives=false``
+        oracle (one collective per leaf)."""
         if not self.zero3_gather:
             return tree
         from dinov3_tpu.parallel.context import get_current_mesh
-        from dinov3_tpu.parallel.sharding import constrain_replicated
+        from dinov3_tpu.parallel.sharding import (
+            constrain_replicated,
+            update_shard_size,
+        )
 
         mesh = get_current_mesh()
         if mesh is None:
             return tree
+        if self.zero3_buckets and update_shard_size(mesh) > 1:
+            from dinov3_tpu.train.fused_update import gather_zero3_bucketed
+
+            return gather_zero3_bucketed(
+                tree, mesh, target_bytes=self.zero3_bucket_bytes)
 
         def walk(sub):
             if not isinstance(sub, dict):

@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from dinov3_tpu.parallel.sharding import constrain_batch_dim
 from dinov3_tpu.train.optimizer import clip_by_per_submodel_norm
 from dinov3_tpu.train.ssl_meta_arch import SSLMetaArch
 
@@ -59,12 +60,58 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
+def split_microbatches(batch: dict, accum_steps: int) -> dict:
+    """Reshape a crop-major collated batch into ``accum_steps`` stacked
+    microbatches for ``lax.scan``.
+
+    Every array leaf is ``[k*B, ...]`` where B is the image batch and k
+    the per-leaf crop multiplicity (2 for global-crop leaves, n_local
+    for local crops, 1 for offsets/labels), stacked CROP-major
+    (collate.py: crop 0 of all images, then crop 1 of all images, ...).
+    A plain leading-dim split would therefore hand microbatch 0 only
+    the first crops of everything. Instead each leaf regroups
+    semantically — ``(k, accum, B/accum, ...)`` -> move the accum axis
+    out front -> ``(accum, k*(B/accum), ...)`` — so microbatch j holds
+    ALL crops of image subset j and is itself a valid crop-major batch
+    (the loss couples crops of one image; the across-image reshuffle
+    bytes this costs are negligible next to the param collectives the
+    accumulation amortizes).
+
+    Scalar leaves broadcast unchanged. Raises when ``accum_steps`` does
+    not divide B (``configs.config.warn_accum_batch_tiling`` warns at
+    config build; this is the traced-shape backstop).
+    """
+    if accum_steps <= 1:
+        return batch
+    b_global = batch["global_crops"].shape[0] // 2
+
+    def _split(x):
+        if getattr(x, "ndim", 0) == 0:
+            return x
+        n = x.shape[0]
+        if n % b_global or b_global % accum_steps:
+            raise ValueError(
+                f"optim.accum_steps={accum_steps} cannot tile a batch "
+                f"leaf of leading dim {n} (image batch {b_global}); "
+                f"pick accum_steps dividing the per-step image batch."
+            )
+        k = n // b_global
+        x = x.reshape((k, accum_steps, b_global // accum_steps)
+                      + x.shape[1:])
+        x = jnp.moveaxis(x, 1, 0)
+        return x.reshape((accum_steps, k * (b_global // accum_steps))
+                         + x.shape[3:])
+
+    return {k: _split(v) for k, v in batch.items()}
+
+
 def make_train_step(
     meta: SSLMetaArch,
     optimizer: optax.GradientTransformation,
     clip_grad: float | None = 3.0,
     monitor_grad_norm: bool = False,
     fused_update: Callable | None = None,
+    accum_steps: int = 1,
 ) -> Callable:
     """Returns step(state, batch, scalars, rng) -> (state, metrics).
 
@@ -77,7 +124,24 @@ def make_train_step(
     must have been built with the same clip_grad/betas/multipliers as
     ``optimizer`` (build_train_setup guarantees this — both are wired
     from the same cfg and schedules).
+
+    ``accum_steps`` (``optim.accum_steps``): microbatched gradient
+    accumulation. The fwd/bwd runs as a ``lax.scan`` over
+    ``split_microbatches(batch)``, rematerialized per microbatch
+    (``jax.checkpoint``), with the zero3 param gathers HOISTED outside
+    the scan as scan constants — the scan-constant transpose sums the
+    per-microbatch cotangents inside the backward scan, so the grad
+    reduce-scatter (the gather's transpose, bucketed under the unified
+    engine) fires ONCE per optimizer step on the summed gradient, not
+    once per microbatch. Loss/metrics/centers are microbatch means, so
+    the optimizer consumes exactly the monolithic batch-mean gradient
+    (up to reduction order) while peak activation memory drops by
+    ~accum_steps. ``accum_steps=1`` is byte-for-byte the monolithic
+    path.
     """
+    if accum_steps < 1:
+        raise ValueError(
+            f"optim.accum_steps must be >= 1, got {accum_steps}")
 
     def step(state: TrainState, batch: dict, scalars: dict, rng: jax.Array):
         it = state.step
@@ -85,29 +149,106 @@ def make_train_step(
         # so draws at iteration k are identical whether the run reached k
         # uninterrupted or restarted from a checkpoint (both rng paths)
         rng = jax.random.fold_in(rng, it)
-        rngs = rng_plan = None
-        if meta.rng_plan:
-            # step-wide RNG plan (rng/plan.py): a handful of large fused
-            # draws replace the per-consumer fold_in chains below — the
-            # copy/small-op dispatch sink the r5 profile priced at 14.8%
-            rng_plan = meta.build_rng_plan(rng, batch)
-        else:
-            rngs = {
-                "drop_path": jax.random.fold_in(rng, 0),
-                "rope": jax.random.fold_in(rng, 1),
-                "dropout": jax.random.fold_in(rng, 2),
-            }
         frozen = {k: v for k, v in state.params.items() if k != "student"}
 
-        def loss_fn(student_params):
-            return meta.forward(
-                student_params, frozen, batch,
-                teacher_temp=scalars["teacher_temp"],
-                state=state.center_state,
-                iteration=it,
-                rngs=rngs,
-                rng_plan=rng_plan,
-            )
+        if accum_steps == 1:
+            rngs = rng_plan = None
+            if meta.rng_plan:
+                # step-wide RNG plan (rng/plan.py): a handful of large
+                # fused draws replace the per-consumer fold_in chains
+                # below — the copy/small-op dispatch sink the r5 profile
+                # priced at 14.8%
+                rng_plan = meta.build_rng_plan(rng, batch)
+            else:
+                rngs = {
+                    "drop_path": jax.random.fold_in(rng, 0),
+                    "rope": jax.random.fold_in(rng, 1),
+                    "dropout": jax.random.fold_in(rng, 2),
+                }
+
+            def loss_fn(student_params):
+                return meta.forward(
+                    student_params, frozen, batch,
+                    teacher_temp=scalars["teacher_temp"],
+                    state=state.center_state,
+                    iteration=it,
+                    rngs=rngs,
+                    rng_plan=rng_plan,
+                )
+
+        else:
+            micro = split_microbatches(batch, accum_steps)
+
+            def loss_fn(student_params):
+                # gather ONCE, outside the microbatch scan: the gathered
+                # trees enter the scan as constants, so autodiff's
+                # scan-constant transpose SUMS the per-microbatch
+                # cotangents inside the backward scan and the gather's
+                # transposed reduce-scatter (one staged RS per bucket
+                # under the unified engine) runs once on the summed
+                # gradient per optimizer step
+                student_g = meta._zero3_gather_params(student_params)
+                frozen_g = meta._zero3_gather_params(frozen)
+
+                def one_micro(sp, fz, mb, rj):
+                    # pin the sliced microbatch back onto the canonical
+                    # batch-dim layout (the put_batch rule): after the
+                    # scan's dynamic-slice the partitioner is free to
+                    # pick any layout for mb, and the forward's
+                    # shard_map islands are reduction-order-sensitive
+                    # to it — unconstrained, the accum arm computes on
+                    # a DIFFERENT layout than the monolithic oracle
+                    # (~1e-2 loss drift at bf16; ~3e-3 activations even
+                    # at fp32 on the 2x4 dryrun mesh)
+                    mb = {
+                        k: constrain_batch_dim(v, 0)
+                        if getattr(v, "ndim", 0) > 0 else v
+                        for k, v in mb.items()
+                    }
+                    rngs_j = plan_j = None
+                    if meta.rng_plan:
+                        plan_j = meta.build_rng_plan(rj, mb)
+                    else:
+                        rngs_j = {
+                            "drop_path": jax.random.fold_in(rj, 0),
+                            "rope": jax.random.fold_in(rj, 1),
+                            "dropout": jax.random.fold_in(rj, 2),
+                        }
+                    loss_j, (ld_j, nc_j) = meta.forward(
+                        sp, fz, mb,
+                        teacher_temp=scalars["teacher_temp"],
+                        state=state.center_state,
+                        iteration=it,
+                        rngs=rngs_j,
+                        rng_plan=plan_j,
+                        gather_params=False,
+                    )
+                    return loss_j, ld_j, nc_j
+
+                # rematerialize per microbatch: live activations are one
+                # microbatch deep, the point of accumulating at all
+                one_micro = jax.checkpoint(one_micro)
+
+                def body(carry, xs):
+                    j, mb = xs
+                    rj = jax.random.fold_in(rng, j)
+                    loss_j, ld_j, nc_j = one_micro(
+                        student_g, frozen_g, mb, rj)
+                    return carry + loss_j, (ld_j, nc_j)
+
+                total, (ld_stack, nc_stack) = jax.lax.scan(
+                    body, jnp.zeros((), jnp.float32),
+                    (jnp.arange(accum_steps), micro),
+                )
+                # microbatch means == monolithic batch means (equal
+                # microbatch sizes; centering EMAs likewise average to
+                # the monolithic update since every microbatch centers
+                # with the same incoming state)
+                mean0 = lambda x: jnp.mean(x, axis=0)  # noqa: E731
+                return total / accum_steps, (
+                    jax.tree.map(mean0, ld_stack),
+                    jax.tree.map(mean0, nc_stack),
+                )
 
         (loss, (loss_dict, new_centers)), grads = jax.value_and_grad(
             loss_fn, has_aux=True

@@ -444,6 +444,18 @@ def classify_collective(line: str) -> str | None:
 # wins (prefetch before stream — the prefetch scope nests inside the
 # stream program).
 HLO_COLLECTIVE_SCOPES = (
+    # the unified zero3 x bucketed engine's hierarchy-aware staged
+    # schedule (parallel/sharding.py hier_gather_bucket): ag_inter =
+    # the slow-tier shard gather, ag_intra = the fast-tier broadcast of
+    # the assembled segments; rs_intra/rs_inter = the hand-written
+    # custom_vjp backward (fast-tier volume reduction first, then the
+    # shrunk cotangent over the slow links). Listed FIRST: these scopes
+    # never nest under another engine scope, but a first-match table
+    # must put the most specific markers before zero3_gather's
+    ("bucket_ag_inter", "bucket_ag_inter"),
+    ("bucket_ag_intra", "bucket_ag_intra"),
+    ("bucket_rs_intra", "bucket_rs_intra"),
+    ("bucket_rs_inter", "bucket_rs_inter"),
     ("zero3_prefetch", "zero3_prefetch"),
     ("zero3_stream", "zero3_stream"),
     ("zero3_gather", "zero3_gather"),

@@ -87,6 +87,22 @@
 #         measures whether the TPU's collective scheduler actually
 #         prices 25x fewer, 10x larger launches the way the host-side
 #         accounting says it should.
+#   phN   unified parallelism engine A/B (buckets x zero3 x grad
+#         accumulation, PR 14: train/fused_update.py
+#         gather_zero3_bucketed + make_zero3_bucket_plan): on the
+#         dp x fsdp mesh, treatment runs the unified arm (non-block
+#         zero3 gathers coalesced into hierarchy-aware staged buckets,
+#         AG inter->intra / grad-RS intra->inter, 21 per-leaf -> 7
+#         buckets at ViT-L 2x4, COST_UNIFIED_r18.json); control strips
+#         ONLY the gather bucketing (optim.bucketed_collectives=false,
+#         per-leaf zero3 gathers) on the identical mesh; a third arm
+#         adds optim.accum_steps=2 on top of the treatment (the
+#         microbatch scan with hoisted gathers — one bucketed RS per
+#         optimizer step; per-microbatch throughput prices the scan
+#         overhead). All arms carry BENCH_CENSUS=1 so the both-tier
+#         scoped collective counts land next to the throughput delta —
+#         whether staging over the real TPU hierarchy (ICI vs DCN)
+#         pays is exactly the question the CPU artifact cannot answer.
 #   phG2  fixed op-level flash-vs-dense attention crossover
 #         (scripts/crossover_attention.py): the
 #         kernels.flash_min_seq=2048 boundary is measured only at
@@ -314,6 +330,23 @@ run_bench phB_bucketed_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=optim.bucketed_collectives=true,train.scan_layers=true
 run_bench phB_bucketed_off_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=optim.bucketed_collectives=false,train.scan_layers=true
+
+# phN: unified parallelism engine A/B (buckets x zero3 x accumulation,
+# PR 14). All arms pin the SAME dp x fsdp=2 zero3 mesh so the only
+# difference is the gather schedule (and, for the accum arm, the
+# microbatch scan). Treatment = hierarchy-aware staged bucket gathers
+# (optim.bucketed_collectives=true on the zero3 mesh — the unified
+# arm); control = per-leaf zero3 gathers (=false) on the identical
+# mesh; accum arm = treatment + optim.accum_steps=2 (one bucketed
+# grad-RS per optimizer step, gathers hoisted out of the scan). The
+# censuses carry the bucket_ag_inter/intra + bucket_rs_* scope counts
+# so the staged-collective story lands next to the throughput delta.
+run_bench phN_unified_on 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=parallel.fsdp=2,parallel.zero3=true,optim.bucketed_collectives=true,train.scan_layers=true
+run_bench phN_unified_perleaf_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=parallel.fsdp=2,parallel.zero3=true,optim.bucketed_collectives=false,train.scan_layers=true
+run_bench phN_unified_accum2 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=parallel.fsdp=2,parallel.zero3=true,optim.bucketed_collectives=true,optim.accum_steps=2,train.scan_layers=true
 
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including

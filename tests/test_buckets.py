@@ -430,8 +430,9 @@ def _setup(extra, batch_size, eight_devices):
 
 def test_setup_born_bucketed_and_toggles(eight_devices):
     """auto-on at dp > 1: moments born as bucket dicts (superseding the
-    per-leaf sharded arm); =false restores the per-leaf oracle; the
-    explicit-true conflicts raise."""
+    per-leaf sharded arm); =false restores the per-leaf oracle; explicit
+    true + zero3 composes (the unified gather-bucket arm) while the
+    remaining non-zero3 conflicts still raise."""
     setup, _ = _setup(["parallel.data=-1"], 8, eight_devices)
     assert setup.bucketed and setup.bucket_plan is not None
     assert not setup.sharded_update  # bucketed supersedes per-leaf
@@ -452,16 +453,14 @@ def test_setup_born_bucketed_and_toggles(eight_devices):
     assert all(l.ndim == 1 for l in
                jax.tree.leaves(setup_off.state.opt_state.adam.mu))
 
-    # explicit true + zero3 is a misconfiguration, not a fallback
-    from dinov3_tpu.data import make_synthetic_batch
-    from dinov3_tpu.train import build_train_setup
-
-    cfg = smol_cfg(["parallel.data=-1", "parallel.zero3=true",
-                    "optim.bucketed_collectives=true"])
-    batch = {k: jnp.asarray(v) for k, v in
-             make_synthetic_batch(cfg, 8, seed=0).items()}
-    with pytest.raises(ValueError, match="bucketed_collectives"):
-        build_train_setup(cfg, batch, devices=eight_devices)
+    # explicit true + zero3 selects the unified gather-bucket arm (the
+    # flat bucketed update stays out of the way: zero3 owns the update)
+    setup_z3, _ = _setup(["parallel.data=-1", "parallel.zero3=true",
+                          "optim.bucketed_collectives=true"], 8,
+                         eight_devices)
+    assert setup_z3.zero3 and setup_z3.zero3_buckets
+    assert setup_z3.zero3_bucket_plan is not None
+    assert not setup_z3.bucketed and setup_z3.bucket_plan is None
     # explicit true + fused off likewise
     with pytest.raises(ValueError, match="bucketed_collectives"):
         _setup(["parallel.data=-1", "optim.fused_update=false",
