@@ -795,6 +795,11 @@ def main():
     accum_warnings = [str(w.message) for w in _bcaught
                       if "optim.accum_steps axis" in str(w.message)
                       or "per-chip microbatch" in str(w.message)]
+    # ... and the seq-padding guardrail (configs/config.py
+    # warn_seq_padding: crop token counts that pad badly against
+    # parallel.seq — every padded position costs real ring FLOPs)
+    seq_pad_warnings = [str(w.message) for w in _bcaught
+                        if "seq-padding axis" in str(w.message)]
     dbatch = put_batch(batch, setup.batch_shardings)
     rng = jax.random.key(0)
     state = setup.state
@@ -1000,6 +1005,8 @@ def main():
         rec["bucket_padding_warning"] = "; ".join(bucket_warnings)
     if accum_warnings:
         rec["accum_tiling_warning"] = "; ".join(accum_warnings)
+    if seq_pad_warnings:
+        rec["seq_padding_warning"] = "; ".join(seq_pad_warnings)
     if degraded:
         # distinct reasons can fire for the global- and local-crop
         # batches of the same program — keep them all

@@ -943,6 +943,91 @@ def warn_serve_cache_memory(cfg: ConfigNode, stacklevel: int = 2) -> str | None:
         budget_mb=budget_mb, stacklevel=stacklevel + 1)
 
 
+# kernels.flash_min_seq="auto" resolves against this committed artifact
+# (repo root), written by ``python scripts/crossover_attention.py
+# CROSSOVER_r19.json`` — the executable threshold definition
+# (``recommended_flash_min_seq``: smallest measured N where the Pallas
+# flash kernel's fwd+bwd beats dense XLA). The artifact-pin test is
+# tests/test_crossover_attention.py.
+CROSSOVER_ARTIFACT = Path(__file__).parents[2] / "CROSSOVER_r19.json"
+
+# Sentinel for "flash never won a measured point": an N no real pass
+# reaches, so dispatch stays dense everywhere without a special case in
+# ops/attention.py (which treats flash_min_seq=0 as "use the baked-in
+# FLASH_MIN_SEQ fallback" — the opposite of what a dense-always
+# crossover verdict means).
+FLASH_NEVER_SEQ = 1 << 30
+
+
+def resolve_flash_min_seq(value: Any, artifact: Path | None = None) -> int:
+    """Resolve ``kernels.flash_min_seq`` to the int the attention modules
+    dispatch on. Ints pass through (0 = the ops-layer FLASH_MIN_SEQ
+    fallback). "auto" (the default) reads ``recommended_flash_min_seq``
+    from the committed crossover artifact: a measured N means flash for
+    passes at least that long; null means flash never won a measured
+    point, resolved to ``FLASH_NEVER_SEQ`` (dense everywhere). A missing
+    or unreadable artifact warns and falls back to 0 so fresh checkouts
+    mid-rederivation still build."""
+    if value is None or value == "":
+        value = "auto"
+    if not isinstance(value, str):
+        return int(value or 0)
+    if value != "auto":
+        return int(value)  # "2048"-style override strings
+    path = CROSSOVER_ARTIFACT if artifact is None else artifact
+    try:
+        import json
+
+        with open(path) as f:
+            rec = json.load(f)
+        n = rec["recommended_flash_min_seq"]
+    except Exception as e:  # noqa: BLE001 - degrade to the ops fallback
+        import warnings
+
+        warnings.warn(
+            f"kernels.flash_min_seq=auto but the crossover artifact "
+            f"{path} is unreadable ({e}); falling back to the ops-layer "
+            f"FLASH_MIN_SEQ default. Re-derive it with "
+            f"scripts/crossover_attention.py.",
+            stacklevel=2,
+        )
+        return 0
+    return FLASH_NEVER_SEQ if n is None else int(n)
+
+
+def warn_seq_padding(
+    n_tokens: int, seq: int, threshold: float = 0.02, stacklevel: int = 2,
+    axis: str = "global crop tokens",
+) -> str | None:
+    """Warn when padding a token axis to a multiple of the seq mesh axis
+    wastes more than ``threshold`` of the padded length — the CLS +
+    register prefix makes N = n_prefix + patches, which is rarely a
+    multiple of ``parallel.seq``, and every padded position costs real
+    attention FLOPs on every device (ring attention masks them by global
+    position but still computes them). Axis-labelled like
+    ``warn_bucket_padding``; fired at setup build (train/setup.py) for
+    each crop size the step will run, and captured into bench records
+    as ``seq_padding_warning`` (bench.py). Returns the message or
+    None."""
+    if seq <= 1 or n_tokens <= 0:
+        return None
+    padded = -(-int(n_tokens) // int(seq)) * int(seq)
+    waste = (padded - n_tokens) / padded
+    if waste <= threshold:
+        return None
+    msg = (
+        f"seq-padding axis [{axis}]: {n_tokens} tokens pad to {padded} "
+        f"for parallel.seq={seq} — {waste:.1%} of every attention pass "
+        f"is masked padding (> {threshold:.0%}). Pick a crop size whose "
+        f"token count (1 + registers + (size/patch)^2) divides the seq "
+        f"axis more evenly, or lower parallel.seq for this stage."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
+
+
 def apply_scaling_rules_to_cfg(cfg: ConfigNode) -> ConfigNode:
     """Batch-size lr scaling, resolved once at load time.
 

@@ -141,19 +141,34 @@ def _flash_available() -> bool:
 # the flash kernel's custom_vjp would block those fusions. Measured
 # full-train-step evidence (v5e): dense wins at N=201 (~1.45x, r1) AND at
 # N=1029 — the 512px ViT-L step runs 9.99 img/s dense vs 7.65 flash
-# (MEASUREMENTS_r5.md phF rows; the committed BENCH_r05_phases.jsonl
-# holds only phA/phB), so the old 1024 threshold flipped to the
+# (MEASUREMENTS_r5.md phF rows), so the old 1024 threshold flipped to the
 # slower path at its first live decision point. 2048 keeps every measured
 # regime on dense while leaving flash reachable where its O(N) memory is
-# the point (768px -> 2309 tokens, ViT-7B long-context); the 2309+ side
-# is pending the fixed op-level crossover (scripts/r5b_queue.sh phG2).
+# the point (768px -> 2309 tokens, ViT-7B long-context).
 #
 # The SOURCE OF TRUTH for module-built models is the config knob
-# ``kernels.flash_min_seq`` (ssl_default_config.yaml, default 2048) —
-# re-derive the threshold from crossover data by editing config, not this
-# file. This constant is only the fallback for direct dispatch_attention
-# calls that pass flash_min_seq=0.
+# ``kernels.flash_min_seq`` (ssl_default_config.yaml, default "auto") —
+# "auto" resolves against the committed op-level crossover artifact
+# CROSSOVER_r19.json via scripts/crossover_attention.py's
+# ``recommended_flash_min_seq`` (configs/config.py
+# ``resolve_flash_min_seq``; the artifact-pin test is
+# tests/test_crossover_attention.py). Re-derive the threshold by
+# re-running the crossover harness on TPU and committing the artifact,
+# not by editing this file. This constant is only the fallback for
+# direct dispatch_attention calls that pass flash_min_seq=0.
 FLASH_MIN_SEQ = 2048
+
+# Below this many tokens ring attention is not worth the rotation: the
+# point of the ring is sharding the O(N) K/V state and the O(N^2)
+# logits-block traffic over the seq axis, and at short N (the 98-201
+# token local crops) the whole dense call is cheaper than size-1 chunks
+# ppermuting around the mesh. Dispatch is per-PASS (q.shape[1]): under
+# one dp x seq mesh the 1029-token 512px globals ring while the locals
+# run dense with seq-replicated activations — the crossover is a memory
+# argument (O(N/s) per device vs O(N)), unlike flash_min_seq's measured
+# time crossover. Config knob: ``kernels.ring_min_seq`` (0 = this
+# fallback); in-step ring tests override it to 1.
+RING_MIN_SEQ = 1024
 
 
 def dispatch_attention(
@@ -201,6 +216,7 @@ class SelfAttention(nn.Module):
     flash_block_q: int = 512   # kernels.flash_block_q/kv caps
     flash_block_kv: int = 512
     flash_min_seq: int = 0     # kernels.flash_min_seq; 0 = FLASH_MIN_SEQ
+    ring_min_seq: int = 0      # kernels.ring_min_seq; 0 = RING_MIN_SEQ
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -260,23 +276,33 @@ class SelfAttention(nn.Module):
                 )
 
         out = None
+        out_token_axis = None  # "seq_tokens" when the ring path engages
         if self.causal:
             # causal runs the dense path (ViT's SSL path never uses it;
             # reference kept a CausalSelfAttention for generative probes)
             out = xla_attention(q, k, v, self.reduce_dtype, causal=True,
                                 probs_dtype=self.probs_dtype)
-        if out is None and self.seq_parallel and seg is None:
-            # ring attention has no segment masking; the meta arch never
-            # combines crop packing with seq parallelism (it falls back
-            # loudly), so seg here only occurs in direct module use
+        if out is None and self.seq_parallel \
+                and N >= (self.ring_min_seq or RING_MIN_SEQ):
+            # per-pass dispatch: only passes long enough to pay for the
+            # rotation ring (RING_MIN_SEQ) — under one dp x seq mesh the
+            # high-res globals ring while short local crops run dense
+            # with seq-replicated activations. Crop-packed rows ride
+            # along: the segment ids thread through the rotating chunks
+            # (parallel/ring_attention.py), same block-diagonal
+            # semantics as the dense/flash seg mask.
             from dinov3_tpu.parallel.context import get_current_mesh
 
             mesh = get_current_mesh()
             if mesh is not None and int(mesh.shape.get("seq", 1)) > 1:
                 from dinov3_tpu.parallel.ring_attention import ring_attention
 
-                out = ring_attention(q, k, v, mesh,
+                out = ring_attention(q, k, v, mesh, seg=seg,
                                      reduce_dtype=self.reduce_dtype)
+                # keep the ring's output seq-sharded ("seq_tokens" rule,
+                # parallel/sharding.py) so the MLP half of the block runs
+                # on N/s tokens per device instead of re-gathering N
+                out_token_axis = "seq_tokens"
         if out is None:
             out = dispatch_attention(
                 q, k, v, self.attn_impl, self.reduce_dtype,
@@ -286,7 +312,8 @@ class SelfAttention(nn.Module):
                 flash_min_seq=self.flash_min_seq,
                 seg=seg,
             )
-        out = constrain(out.reshape(B, N, self.dim), ("batch", None, "embed_act"))
+        out = constrain(out.reshape(B, N, self.dim),
+                        ("batch", out_token_axis, "embed_act"))
 
         proj_kernel = self.param(
             "proj_kernel", part(trunc_normal_init(), ("heads", "embed")),

@@ -49,6 +49,7 @@ class SelfAttentionBlock(nn.Module):
     flash_block_q: int = 512
     flash_block_kv: int = 512
     flash_min_seq: int = 0
+    ring_min_seq: int = 0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -90,7 +91,8 @@ class SelfAttentionBlock(nn.Module):
             fp8=self.fp8, causal=self.causal,
             flash_block_q=self.flash_block_q,
             flash_block_kv=self.flash_block_kv,
-            flash_min_seq=self.flash_min_seq, dtype=self.dtype,
+            flash_min_seq=self.flash_min_seq,
+            ring_min_seq=self.ring_min_seq, dtype=self.dtype,
             param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
             probs_dtype=self.probs_dtype,
             name="attn",

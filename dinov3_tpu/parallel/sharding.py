@@ -31,6 +31,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DEFAULT_LOGICAL_RULES = (
     ("batch", ("dcn_data", "data", "fsdp")),
     ("seq_act", "seq"),
+    # seq-sharded token axis of ATTENTION OUTPUTS under ring attention
+    # (ops/attention.py): a separate name from "seq_act" so the high-res
+    # stage can pin the ring path's activations to the seq axis without
+    # re-labelling every dense-path token dim (which stays replicated —
+    # short local crops never ring). Same mesh axis either way.
+    ("seq_tokens", "seq"),
     ("embed", "fsdp"),
     ("heads", "tensor"),
     ("mlp", "tensor"),

@@ -27,6 +27,7 @@ specs (dinov3_jax/train/train.py:319-604). Here:
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Callable
 
 import jax
@@ -170,6 +171,24 @@ def _build_train_setup(
     from dinov3_tpu.parallel.context import set_current_mesh
 
     set_current_mesh(mesh)
+    if int(mesh.shape.get("seq", 1)) > 1 and bool(
+            cfg.train.get("scan_layers", False)):
+        # flax nn.scan's broadcast partial-eval poisons cached jaxprs of
+        # the ring attention custom_vjp with stale tracers on this jax
+        # release (UnexpectedTracerError at the first grad trace, even
+        # without a lower()-then-call retrace). Fall back loudly rather
+        # than let the step die deep inside the trace; the unscanned
+        # block stack is numerically identical, it only compiles O(depth)
+        # slower. tests/test_ring_attention.py exercises the seq mesh on
+        # the unscanned path.
+        warnings.warn(
+            "train.scan_layers=true is incompatible with ring attention "
+            "on a parallel.seq>1 mesh under this jax version (nn.scan x "
+            "custom_vjp tracer leak); disabling scan_layers for this "
+            "run.",
+            stacklevel=2,
+        )
+        cfg.train.scan_layers = False
     meta = SSLMetaArch(cfg)
     schedules = build_schedules(cfg)
 
@@ -451,6 +470,35 @@ def _build_train_setup(
         from dinov3_tpu.configs.config import warn_accum_batch_tiling
 
         warn_accum_batch_tiling(cfg, mesh=mesh)
+    # seq-padding guardrail: under sequence parallelism each crop's
+    # token count (CLS + registers + patches) pads to a multiple of the
+    # seq axis inside ring attention; warn per crop size when that
+    # padding wastes > 2% of every attention pass. Only passes the
+    # per-pass dispatch actually rings (N >= kernels.ring_min_seq) are
+    # checked — short local crops run dense with no seq padding.
+    seq_axis = int(mesh.shape.get("seq", 1))
+    if seq_axis > 1 and not str(cfg.student.arch).startswith("convnext"):
+        from dinov3_tpu.configs.config import warn_seq_padding
+        from dinov3_tpu.ops.attention import RING_MIN_SEQ
+
+        kernels = cfg.get("kernels") or {}
+        ring_min = int(kernels.get("ring_min_seq", 0) or 0) or RING_MIN_SEQ
+        n_prefix = 1 + int(cfg.student.get("n_storage_tokens", 0) or 0)
+        patch = int(cfg.student.patch_size)
+        crops = cfg.get("crops") or {}
+        sizes = {
+            "global crops": crops.get("global_crops_size", 0),
+            "local crops": crops.get("local_crops_size", 0),
+            "gram teacher crops": crops.get("gram_teacher_crops_size", 0),
+        }
+        for label, px in sizes.items():
+            px = int(px or 0)
+            if px <= 0 or px % patch:
+                continue
+            n = n_prefix + (px // patch) ** 2
+            if n >= ring_min:
+                warn_seq_padding(
+                    n, seq_axis, axis=f"{label} ({px}px)", stacklevel=2)
     raw_step = make_train_step(
         meta, optimizer,
         clip_grad=cfg.optim.clip_grad,

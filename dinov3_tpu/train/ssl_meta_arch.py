@@ -270,14 +270,11 @@ class SSLMetaArch:
                 f"parallelism (parallel.pipe={pipe}); falling back to "
                 "the two-pass student forward for this run")
             return False
-        seq = int((cfg.get("parallel") or {}).get("seq", 1) or 1)
-        if seq > 1:
-            warnings.warn(
-                "model.crop_packing is not supported under sequence "
-                f"parallelism (parallel.seq={seq}: ring attention has "
-                "no segment masking); falling back to the two-pass "
-                "student forward for this run")
-            return False
+        # seq parallelism no longer forfeits packing: ring attention
+        # threads the packed segment ids through its rotating K/V chunks
+        # (parallel/ring_attention.py), so the block-diagonal mask holds
+        # on the seq-sharded path too (tests/test_ring_attention.py pins
+        # the packed+seq composition).
         from dinov3_tpu.ops.packing import layout_from_cfg
 
         layout = layout_from_cfg(cfg, int(cfg.train.batch_size_per_device))

@@ -472,6 +472,14 @@ HLO_COLLECTIVE_SCOPES = (
     ("update_shard", "update_shard"),
     ("crop_pack", "gather_pack"),
     ("crop_unpack", "gather_pack"),
+    # ring attention (parallel/ring_attention.py): ring_permute = the
+    # rotating K/V(+segment) chunk ppermutes of the forward and of the
+    # custom_vjp's second ring pass (where the dk/dv accumulators
+    # co-rotate); ring_merge = the island boundary — any reshard GSPMD
+    # inserts to feed the seq-sharded islands. ring_permute first: the
+    # permute scope nests inside the boundary scope.
+    ("ring_permute", "ring_permute"),
+    ("ring_merge", "ring_merge"),
     ("telemetry_ring", "telemetry"),
 )
 
@@ -479,8 +487,7 @@ HLO_COLLECTIVE_SCOPES = (
 def classify_collective_scope(line: str) -> str:
     """Named-scope attribution category for one collective HLO line
     (``HLO_COLLECTIVE_SCOPES``), or "other" when no engine scope claims
-    it (model-structure collectives: grad all-reduces, loss psums,
-    ring ppermutes)."""
+    it (model-structure collectives: grad all-reduces, loss psums)."""
     for marker, cat in HLO_COLLECTIVE_SCOPES:
         if marker in line:
             return cat

@@ -3,27 +3,32 @@ definition of the ``kernels.flash_min_seq`` dispatch threshold.
 
 The full-step high-res benches compile for 20-40+ min through the axon
 tunnel helper and have wedged it twice; this measures the SAME dispatch
-decision (``dinov3_tpu/ops/attention.py``, config default
-``kernels.flash_min_seq=2048``) with tiny fwd+bwd programs that compile
-in seconds, at the token counts the recipes actually produce
-(224px->201, 512px->1029, 518px->1054, 768px->2309, plus 4096).
+decision (``dinov3_tpu/ops/attention.py``; config default
+``kernels.flash_min_seq: auto`` resolves from THIS script's committed
+artifact) with tiny fwd+bwd programs that compile in seconds, at the
+token counts the recipes actually produce (224px->201, 512px->1029,
+518px->1054, 768px->2309, plus 4096).
 
 The threshold's definition is ``recommended_flash_min_seq``: the
 smallest measured N at which the Pallas flash kernel beats dense XLA on
 fwd+bwd wall time — dispatch flash for N >= that, dense below (None =
-flash never won a measured point; keep dense everywhere). The r5
-full-step evidence (dense beats flash at N=201 AND N=1029, r6 queue
-phG2 fills 2048-2309 and the flash side) anchors the committed 2048;
-re-running this script on-chip re-derives it from data instead of two
-full-step points.
+flash never won a measured point; keep dense everywhere). The committed
+CROSSOVER_r19.json is this harness's verdict on the current platform
+(``configs/config.py resolve_flash_min_seq`` reads it; on the CPU
+harness interpret-mode Pallas never wins, so the verdict is null =
+dense everywhere). Re-derive on-chip (r6 queue phH) and commit the new
+artifact over it — never hand-edit the threshold.
 
 Prints one JSON line per (N, impl) with ms/call, then a crossover
-summary with the derived threshold. A slow-marked CPU test
-(tests/test_crossover_attention.py) keeps the harness collectable and
-the threshold definition pinned off-chip.
+summary with the derived threshold. An out path ending in ``.json``
+switches to committed-artifact mode (one JSON document). CPU tests
+(tests/test_crossover_attention.py) keep the harness collectable, the
+threshold definition pinned, and the committed artifact well-formed.
 
-Usage: python scripts/crossover_attention.py [out.jsonl]
+Usage: python scripts/crossover_attention.py [out.jsonl|out.json]
 Env: XOVER_MAX_N (skip cases above N), XOVER_STEPS (20),
+     XOVER_WARMUP (3; lower it on interpreted-Pallas CPU runs where a
+     single flash call can take seconds),
      XOVER_CASES ("B1xN1,B2xN2,..." overrides the case ladder).
 """
 
@@ -166,6 +171,7 @@ def main():
     if os.environ.get("XOVER_MAX_N"):  # CPU smoke: skip the big cases
         cases = [c for c in cases if c[1] <= int(os.environ["XOVER_MAX_N"])]
     steps = int(os.environ.get("XOVER_STEPS", "20"))
+    warmup = int(os.environ.get("XOVER_WARMUP", "3"))
 
     with open(out_path, "a") as out:
         def emit(rec):
@@ -174,7 +180,8 @@ def main():
             out.write(line + "\n")
             out.flush()
 
-        records = measure_crossover(cases, steps=steps, emit=emit)
+        records = measure_crossover(cases, steps=steps, warmup=warmup,
+                                    emit=emit)
         summary = crossover_summary(records)
         line = json.dumps({
             "crossover": summary,
@@ -182,6 +189,25 @@ def main():
         })
         print(line, flush=True)
         out.write(line + "\n")
+
+    if out_path.endswith(".json"):
+        # committed-artifact mode (CROSSOVER_r19.json): one JSON document
+        # the config resolver (configs/config.py resolve_flash_min_seq)
+        # and the artifact-pin test read — overwrites the JSONL stream
+        # written above with the final combined record.
+        doc = {
+            "generated_by": "scripts/crossover_attention.py",
+            "platform": jax.devices()[0].platform,
+            "jax": jax.__version__,
+            "heads": HEADS, "head_dim": HEAD_DIM,
+            "steps": steps,
+            "records": records,
+            "crossover": summary,
+            "recommended_flash_min_seq": recommended_flash_min_seq(summary),
+        }
+        with open(out_path, "w") as out:
+            json.dump(doc, out, indent=1)
+            out.write("\n")
 
 
 if __name__ == "__main__":

@@ -110,6 +110,21 @@
 #         unmeasured (ADVICE r5 low). Seconds-long compiles, banks the
 #         crossover table + the executable recommended_flash_min_seq
 #         the threshold cites.
+#   phH   high-res gram-anchoring stage A/B (PR 15, sequence-sharded
+#         segment-masked ring attention): treatment runs the 512px
+#         gram stage on a dp x seq=2 mesh (ring path on the 1029-token
+#         globals, per-pass kernels.ring_min_seq dispatch keeps locals
+#         dense); control is the identical gram stage on the pure-dp
+#         mesh (dense attention, seq=1). Both arms carry BENCH_CENSUS=1
+#         so the ring_permute-scoped ppermute counts/bytes and the
+#         seq_padding_warning land next to the throughput delta — the
+#         CPU artifact (COST_HIRES_r19.json) prices the memory, only
+#         the chip prices the rotation. Then re-derives the crossover
+#         artifact on-chip (scripts/crossover_attention.py in
+#         committed-JSON mode): CROSSOVER_r19.json's cpu verdict
+#         (recommended_flash_min_seq=null, interpret-mode Pallas) is a
+#         placeholder for exactly this run — commit the on-chip JSON
+#         over it wholesale.
 #   phE   continuous-packing serve engine A/B (the ragged-traffic
 #         inference attack, dinov3_tpu/serve/): scripts/bench_serve.py
 #         runs all three arms — packed (serve.continuous_packing
@@ -363,6 +378,34 @@ if gate_phase 2400 phG2_attn_crossover; then
     else
         note "FAIL  phG2_attn_crossover rc=$?"
         echo "{\"tag\": \"phG2_attn_crossover\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
+    fi
+fi
+
+# phH: high-res gram-anchoring stage A/B (PR 15). Treatment = 512px
+# gram stage on the dp x seq=2 mesh (ring attention on the 1029-token
+# globals; kernels.ring_min_seq=1024 keeps the short local crops
+# dense); control = the identical gram stage on the pure-dp mesh.
+# scan_layers pinned OFF on both arms: seq>1 would force-disable it
+# anyway (the nn.scan x custom_vjp tracer leak, train/setup.py) and
+# the control must compile the same unscanned stack to be comparable.
+run_bench phH_hires_ring_seq2 2700 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=crops.global_crops_size=512,crops.gram_teacher_crops_size=512,gram.use_loss=true,gram.ema_teacher=false,parallel.seq=2,train.scan_layers=false
+run_bench phH_hires_dense_seq1_ctl 2700 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=crops.global_crops_size=512,crops.gram_teacher_crops_size=512,gram.use_loss=true,gram.ema_teacher=false,train.scan_layers=false
+
+# ... and the committed-artifact crossover re-derivation: same harness
+# as phG2 but in committed-JSON mode — the on-chip replacement for
+# CROSSOVER_r19.json's cpu-verdict placeholder (flash_min_seq=auto
+# resolves from this file; copy it over the repo root's and commit).
+if gate_phase 2400 phH_crossover_artifact; then
+    note "start phH_crossover_artifact"
+    if timeout 2400 python scripts/crossover_attention.py \
+            /tmp/CROSSOVER_r19_onchip.json >> "$LOG" 2>&1; then
+        note "done  phH_crossover_artifact -> /tmp/CROSSOVER_r19_onchip.json"
+        echo "{\"tag\": \"phH_crossover_artifact\", \"rc\": 0, \"result\": $(python -c 'import json,sys; d=json.load(open("/tmp/CROSSOVER_r19_onchip.json")); print(json.dumps({"platform": d["platform"], "recommended_flash_min_seq": d["recommended_flash_min_seq"], "crossover": d["crossover"]}))')}" >> "$RESULTS"
+    else
+        note "FAIL  phH_crossover_artifact rc=$?"
+        echo "{\"tag\": \"phH_crossover_artifact\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
     fi
 fi
 

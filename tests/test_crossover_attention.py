@@ -61,6 +61,65 @@ def test_parse_cases():
     assert xo.parse_cases("16x201,4x1029") == [(16, 201), (4, 1029)]
 
 
+def test_committed_crossover_artifact_pins_flash_min_seq():
+    """CROSSOVER_r19.json is the committed source of the
+    ``kernels.flash_min_seq=auto`` default: well-formed, produced by the
+    harness under test, and its recommendation round-trips through the
+    config resolver exactly as the threshold definition says."""
+    import json
+
+    from dinov3_tpu.configs.config import (
+        CROSSOVER_ARTIFACT,
+        FLASH_NEVER_SEQ,
+        resolve_flash_min_seq,
+    )
+
+    assert CROSSOVER_ARTIFACT.exists(), (
+        "CROSSOVER_r19.json missing — re-derive with "
+        "scripts/crossover_attention.py CROSSOVER_r19.json")
+    with open(CROSSOVER_ARTIFACT) as f:
+        doc = json.load(f)
+    assert doc["generated_by"] == "scripts/crossover_attention.py"
+    assert {"platform", "records", "crossover",
+            "recommended_flash_min_seq"} <= set(doc)
+    # the recommendation must be re-derivable from the committed summary
+    xo = _load()
+    rec = doc["recommended_flash_min_seq"]
+    assert rec == xo.recommended_flash_min_seq(doc["crossover"])
+    # and the resolver dispatches on it: a measured N passes through, a
+    # null (flash never won — the CPU-harness verdict) means dense
+    # everywhere via the effectively-infinite sentinel
+    resolved = resolve_flash_min_seq("auto")
+    assert resolved == (FLASH_NEVER_SEQ if rec is None else int(rec))
+
+
+def test_resolve_flash_min_seq_paths(tmp_path):
+    """The resolver's four paths: int pass-through, string override,
+    auto-from-artifact (int and null), unreadable-artifact fallback."""
+    import json
+    import warnings
+
+    from dinov3_tpu.configs.config import (
+        FLASH_NEVER_SEQ,
+        resolve_flash_min_seq,
+    )
+
+    assert resolve_flash_min_seq(2048) == 2048
+    assert resolve_flash_min_seq(0) == 0
+    assert resolve_flash_min_seq("2048") == 2048
+    good = tmp_path / "xover.json"
+    good.write_text(json.dumps({"recommended_flash_min_seq": 2309}))
+    assert resolve_flash_min_seq("auto", artifact=good) == 2309
+    never = tmp_path / "never.json"
+    never.write_text(json.dumps({"recommended_flash_min_seq": None}))
+    assert resolve_flash_min_seq("auto", artifact=never) == FLASH_NEVER_SEQ
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        got = resolve_flash_min_seq("auto", artifact=tmp_path / "nope.json")
+    assert got == 0
+    assert any("crossover artifact" in str(w.message) for w in caught)
+
+
 @pytest.mark.slow
 def test_measure_crossover_collects_on_cpu():
     """The harness runs end-to-end on the CPU backend: dense-XLA rows
