@@ -63,31 +63,54 @@ def cast_stream_leaves(stack_params: Any, dtype) -> Any:
     return jtu.tree_map_with_path(leaf, stack_params)
 
 
+def prefetch_depth(prefetch: bool | int) -> int:
+    """Normalize the stream-prefetch knob to an integer lookahead
+    depth: ``False``/0 = gather at use, ``True``/1 = the classic
+    double buffer (gather i+1 under block i's compute), ``d >= 2`` = a
+    ``d``-deep gather pipeline (the carry holds ``d`` gathered sets —
+    liveness grows one block's weights per extra depth). Booleans map
+    to 0/1 so every pre-tuner call site keeps its exact schedule; the
+    integer form is the tuner's candidate axis
+    (``optim.stream_prefetch``, resolve_stream_prefetch)."""
+    depth = int(prefetch)
+    if depth < 0:
+        raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+    return depth
+
+
 def streamed_block_scan(
     block_apply: Callable,
     stack_params: Any,
     x: jnp.ndarray,
     n_blocks: int,
     mesh=None,
-    prefetch: bool = True,
+    prefetch: bool | int = True,
 ):
-    """Run ``n_blocks`` blocks over ``x`` with an explicit double-
-    buffered weight stream.
+    """Run ``n_blocks`` blocks over ``x`` with an explicit
+    ``prefetch``-deep buffered weight stream.
 
     ``block_apply(block_params, x) -> x``: one block's pure apply (e.g.
     a bound ``SelfAttentionBlock.apply``). ``stack_params``: pytree of
     ``[n_blocks, ...]`` leaves, sharded over the data axes on non-layer
     dims (the zero3 layout — the per-block slice is then shard-local
-    and only the materialization moves bytes). ``prefetch=True`` is the
-    double-buffered schedule (gather i+1 under block i's compute, scope
-    ``zero3_prefetch``); ``prefetch=False`` gathers each block at use
+    and only the materialization moves bytes). ``prefetch`` is the
+    integer lookahead depth (``prefetch_depth``): depth 1 (= the old
+    ``True``) is the double-buffered schedule — gather i+1 under block
+    i's compute, scope ``zero3_prefetch``; depth ``d`` issues block
+    i+d's gather there, giving the scheduler ``d`` blocks of compute to
+    hide each gather under at the price of ``d`` live gathered weight
+    sets. Depth 0 (= the old ``False``) gathers each block at use
     (scope ``zero3_stream``) — the A/B control for the overlap census.
+    The gathers are pure movement, so every depth is bitwise-identical
+    in values; only the wire schedule changes.
     """
     if mesh is None:
         from dinov3_tpu.parallel.context import get_current_mesh
 
         mesh = get_current_mesh()
     from dinov3_tpu.parallel.sharding import constrain_replicated
+
+    depth = prefetch_depth(prefetch)
 
     def gather_block(i, scope):
         def leaf(p):
@@ -97,29 +120,32 @@ def streamed_block_scan(
         with jax.named_scope(scope):
             return jax.tree.map(leaf, stack_params)
 
-    if not prefetch:
+    if depth == 0:
         def body_at_use(x, i):
             return block_apply(gather_block(i, "zero3_stream"), x), None
 
         x, _ = jax.lax.scan(body_at_use, x, jnp.arange(n_blocks))
         return x
 
-    # prime the buffer: block 0's weights gathered before the loop
-    w0 = gather_block(jnp.asarray(0), "zero3_gather")
+    # prime the buffer: blocks [0, depth) gathered before the loop
+    buf0 = tuple(
+        gather_block(jnp.asarray(min(j, n_blocks - 1)), "zero3_gather")
+        for j in range(depth))
 
     def body(carry, i):
-        x, w = carry
-        # issue block i+1's gather BEFORE block i's compute — no data
-        # dependency between them, so the scheduler can run the gather
-        # under the compute (the last iteration re-gathers the final
-        # block into a dead carry slot: one wasted gather per pass, the
-        # price of a static-shape double buffer)
+        x, buf = carry
+        # issue block i+depth's gather BEFORE block i's compute — no
+        # data dependency between them, so the scheduler can run the
+        # gather under the next ``depth`` blocks of compute (the tail
+        # iterations re-gather the final block into dead carry slots:
+        # ``depth`` wasted gathers per pass, the price of a
+        # static-shape buffer)
         w_next = gather_block(
-            jnp.minimum(i + 1, n_blocks - 1), "zero3_prefetch")
-        x = block_apply(w, x)
-        return (x, w_next), None
+            jnp.minimum(i + depth, n_blocks - 1), "zero3_prefetch")
+        x = block_apply(buf[0], x)
+        return (x, buf[1:] + (w_next,)), None
 
-    (x, _), _ = jax.lax.scan(body, (x, w0), jnp.arange(n_blocks))
+    (x, _), _ = jax.lax.scan(body, (x, buf0), jnp.arange(n_blocks))
     return x
 
 
@@ -182,9 +208,10 @@ def bucketed_stream_scan(
     bucket_shards: jnp.ndarray,
     x: jnp.ndarray,
     mesh=None,
-    prefetch: bool = True,
+    prefetch: bool | int = True,
     consume_fn: Callable | None = None,
     hierarchical: bool = False,
+    staging_order: str = "inter_intra",
 ):
     """The BUCKETED forward weight-gather schedule, written explicitly —
     ``streamed_block_scan``'s double-buffer convention lifted from
@@ -199,23 +226,26 @@ def bucketed_stream_scan(
     compute).
 
     ``bucket_shards``: ``[n_buckets, S_pb]`` from ``pack_stream_buckets``
-    (dim 1 sharded over the data axes by the in_spec). ``prefetch=True``
-    gathers bucket i+1 under bucket i's consume (scope
-    ``bucket_prefetch``, priming gather ``bucket_gather``);
-    ``prefetch=False`` gathers at use (scope ``bucket_stream``) — the
-    A/B control. ``consume_fn(w_full, x) -> x`` consumes one gathered
+    (dim 1 sharded over the data axes by the in_spec). ``prefetch`` is
+    the integer lookahead depth (``prefetch_depth``; booleans map to
+    0/1): depth ``d >= 1`` gathers bucket i+d under bucket i's consume
+    (scope ``bucket_prefetch``, priming gathers ``bucket_gather``);
+    depth 0 gathers at use (scope ``bucket_stream``) — the A/B
+    control. ``consume_fn(w_full, x) -> x`` consumes one gathered
     bucket; the default is a cheap reduction coupling every weight
     element into ``x`` (pass-granularity convention of the cost
     scripts — the census prices the collective schedule, not the block
     math).
 
     ``hierarchical=True`` replaces each flat all-gather with the
-    unified engine's STAGED schedule on a dp×fsdp mesh (inter tier
-    first — the slow links move 1/dp shards — then intra, scopes
-    ``bucket_ag_inter``/``bucket_ag_intra``), followed by an
-    index-order-restoring ``swapaxes``+``reshape`` so the consumed
-    vector is BITWISE the flat gather's device-order concat: the
-    option changes the wire schedule, never the numerics. With one
+    unified engine's STAGED schedule on a dp×fsdp mesh, the tiers
+    released per ``staging_order``'s AG half (parallel/sharding.py
+    ``split_staging_order``; the default moves 1/dp shards over the
+    slow inter links first, then intra, scopes ``bucket_ag_inter``/
+    ``bucket_ag_intra`` — the RS half rides the autodiff transpose
+    here), followed by an index-order-restoring reshape so the
+    consumed vector is BITWISE the flat gather's device-order concat:
+    the options change the wire schedule, never the numerics. With one
     present mesh tier it degrades to the flat gather unchanged.
     """
     if mesh is None:
@@ -228,11 +258,14 @@ def bucketed_stream_scan(
     from dinov3_tpu.parallel.sharding import (
         UPDATE_SHARD_AXES,
         hierarchy_axes,
+        split_staging_order,
     )
 
     axes = tuple(a for a in UPDATE_SHARD_AXES if a in mesh.shape)
     inter, intra = hierarchy_axes(mesh)
     staged = bool(hierarchical and inter and intra)
+    ag_first, _ = split_staging_order(staging_order)
+    depth = prefetch_depth(prefetch)
     n_buckets = int(bucket_shards.shape[0])
     if consume_fn is None:
         def consume_fn(w, x):
@@ -242,38 +275,49 @@ def bucketed_stream_scan(
         def gather(i, scope):
             s = jax.lax.dynamic_index_in_dim(shards, i, 0, keepdims=False)
             if staged:
-                # inter-first staged gather, then restore flat device
-                # order: [n_intra, n_inter, cols] -> swap -> reshape
-                # gives exactly the flat tiled gather's concat
-                with jax.named_scope("bucket_ag_inter"):
-                    g = jax.lax.all_gather(s, inter, tiled=False)
+                # staged gather, then restore flat device order: the
+                # flat tiled gather concats inter-major (device-id
+                # order), i.e. a [n_inter, n_intra, cols] raveling —
+                # inter-first stacks [n_intra, n_inter, cols] and
+                # swaps; intra-first lands inter-major directly
+                if ag_first == "inter":
+                    with jax.named_scope("bucket_ag_inter"):
+                        g = jax.lax.all_gather(s, inter, tiled=False)
+                    with jax.named_scope("bucket_ag_intra"):
+                        g = jax.lax.all_gather(g, intra, tiled=False)
+                    with jax.named_scope(scope):
+                        return jnp.swapaxes(g, 0, 1).reshape(-1)
                 with jax.named_scope("bucket_ag_intra"):
-                    g = jax.lax.all_gather(g, intra, tiled=False)
+                    g = jax.lax.all_gather(s, intra, tiled=False)
+                with jax.named_scope("bucket_ag_inter"):
+                    g = jax.lax.all_gather(g, inter, tiled=False)
                 with jax.named_scope(scope):
-                    return jnp.swapaxes(g, 0, 1).reshape(-1)
+                    return g.reshape(-1)
             with jax.named_scope(scope):
                 return jax.lax.all_gather(s, axes, tiled=True)
 
-        if not prefetch:
+        if depth == 0:
             def at_use(x, i):
                 return consume_fn(gather(i, "bucket_stream"), x), None
 
             x, _ = jax.lax.scan(at_use, x, jnp.arange(n_buckets))
             return x
 
-        # prime the buffer: bucket 0 gathered before the loop
-        w0 = gather(jnp.asarray(0), "bucket_gather")
+        # prime the buffer: buckets [0, depth) gathered before the loop
+        buf0 = tuple(
+            gather(jnp.asarray(min(j, n_buckets - 1)), "bucket_gather")
+            for j in range(depth))
 
         def step(carry, i):
-            x, w = carry
-            # issue bucket i+1's gather BEFORE consuming bucket i — the
-            # streamed_block_scan double buffer, per bucket
+            x, buf = carry
+            # issue bucket i+depth's gather BEFORE consuming bucket i —
+            # the streamed_block_scan lookahead, per bucket
             w_next = gather(
-                jnp.minimum(i + 1, n_buckets - 1), "bucket_prefetch")
-            x = consume_fn(w, x)
-            return (x, w_next), None
+                jnp.minimum(i + depth, n_buckets - 1), "bucket_prefetch")
+            x = consume_fn(buf[0], x)
+            return (x, buf[1:] + (w_next,)), None
 
-        (x, _), _ = jax.lax.scan(step, (x, w0), jnp.arange(n_buckets))
+        (x, _), _ = jax.lax.scan(step, (x, buf0), jnp.arange(n_buckets))
         return x
 
     return shard_map_compat(

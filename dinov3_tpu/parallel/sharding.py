@@ -477,39 +477,77 @@ def _constrain3(x: jax.Array, mesh: Mesh, spec: P, scope: str) -> jax.Array:
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
-def hier_gather_bucket(x: jax.Array, mesh: Mesh) -> jax.Array:
+STAGING_ORDERS = ("inter_intra", "intra_inter", "inter_inter",
+                  "intra_intra")
+
+
+def split_staging_order(order: str) -> tuple[str, str]:
+    """``"<ag>_<rs>"`` -> ``(ag_first, rs_first)``, each "inter" or
+    "intra" naming the tier the forward all-gather (resp. backward
+    reduce-scatter) releases FIRST. "inter_intra" is the hand-set
+    bandwidth-model default: AG moves the small 1/dp shards over the
+    slow inter links first, RS shrinks the cotangent n_intra-fold on
+    the fast links before it touches a slow one (PAPERS.md
+    2408.13356). The other three orders are the tuner's A/B candidates
+    (scripts/tune_collectives.py) — pure wire-schedule permutations of
+    the same data movement."""
+    if order not in STAGING_ORDERS:
+        raise ValueError(
+            f"staging order {order!r}: expected one of {STAGING_ORDERS}")
+    ag, rs = order.split("_")
+    return ag, rs
+
+
+def hier_gather_bucket(
+    x: jax.Array, mesh: Mesh, staging_order: str = "inter_intra",
+) -> jax.Array:
     """Replicate one flat gather bucket with the hierarchy-aware
     two-stage schedule, differentiable with direction-true scope names.
 
     ``x``: ``[n_inter, n_intra, cols]`` sharded per ``hier_bucket_spec``
     (device ``(i_inter, i_intra)`` holds element ``[i_inter, i_intra,
     :]`` — its own shard, so the pack that built the bucket was
-    shard-local). Forward constrains dim 0 replicated under
+    shard-local). Forward releases the tiers in ``staging_order``'s AG
+    half — the default constrains dim 0 replicated under
     ``bucket_ag_inter`` (the slow tier moves 1/dp-sized shards), then
     dim 1 replicated under ``bucket_ag_intra`` (the fast tier
-    broadcasts the assembled segments). Pure data movement — values are
-    bitwise whatever the staging.
+    broadcasts the assembled segments); "intra"-first releases dim 1
+    before dim 0. Pure data movement — values are bitwise whatever the
+    staging; the scopes keep their tier names under either order.
 
     The backward is a hand-written ``custom_vjp``, NOT the autodiff
     transpose: a transposed sharding constraint keeps the FORWARD
     scope in its ``op_name`` (``transpose(bucket_ag_inter)``), so the
     census could never tell the grad reduce-scatters from the gathers.
-    The bwd applies the reverse staging to the cotangent — intra tier
-    first (``bucket_rs_intra``: the fast links do the n_intra-fold
-    volume reduction), then inter (``bucket_rs_inter``) — and GSPMD
-    materializes the partial-sum reductions as reduce-scatters at
-    exactly these constraint points.
+    The bwd applies ``staging_order``'s RS half to the cotangent — the
+    default reduce-scatters the intra tier first (``bucket_rs_intra``:
+    the fast links do the n_intra-fold volume reduction), then inter
+    (``bucket_rs_inter``) — and GSPMD materializes the partial-sum
+    reductions as reduce-scatters at exactly these constraint points.
+    NOTE the RS order permutes the floating-point partial-sum tree
+    across tiers, so A/B candidates match to reduction tolerance, not
+    bitwise (tests/test_tuning.py pins both properties).
     """
     inter, intra = hierarchy_axes(mesh)
     if not inter and not intra:
         return x
+    ag_first, rs_first = split_staging_order(staging_order)
     sharded = P(inter or None, intra or None, None)
-    half = P(None, intra or None, None)
+    # the intermediate layout after releasing one tier, keyed by which
+    # tier went first (releasing an absent tier is a no-op constraint,
+    # so single-tier meshes collapse to one stage under either order)
+    inter_done = P(None, intra or None, None)
+    intra_done = P(inter or None, None, None)
 
     def _primal(b):
-        if inter:
-            b = _constrain3(b, mesh, half, "bucket_ag_inter")
-        return _constrain3(b, mesh, P(None, None, None), "bucket_ag_intra")
+        if ag_first == "inter":
+            if inter:
+                b = _constrain3(b, mesh, inter_done, "bucket_ag_inter")
+            return _constrain3(
+                b, mesh, P(None, None, None), "bucket_ag_intra")
+        if intra:
+            b = _constrain3(b, mesh, intra_done, "bucket_ag_intra")
+        return _constrain3(b, mesh, P(None, None, None), "bucket_ag_inter")
 
     @jax.custom_vjp
     def gather(b):
@@ -519,9 +557,14 @@ def hier_gather_bucket(x: jax.Array, mesh: Mesh) -> jax.Array:
         return _primal(b), None
 
     def bwd(_, ct):
-        ct = _constrain3(ct, mesh, half, "bucket_rs_intra")
-        if inter:
-            ct = _constrain3(ct, mesh, sharded, "bucket_rs_inter")
+        if rs_first == "intra":
+            ct = _constrain3(ct, mesh, inter_done, "bucket_rs_intra")
+            if inter:
+                ct = _constrain3(ct, mesh, sharded, "bucket_rs_inter")
+        else:
+            if inter:
+                ct = _constrain3(ct, mesh, intra_done, "bucket_rs_inter")
+            ct = _constrain3(ct, mesh, sharded, "bucket_rs_intra")
         return (ct,)
 
     gather.defvjp(fwd, bwd)

@@ -1683,7 +1683,8 @@ def _zero3_member_unrows(rows, member: Zero3BucketMember):
 
 def gather_zero3_bucketed(tree: Any, mesh,
                           target_bytes: int = 128 * 2 ** 20,
-                          plan: Zero3GatherPlan | None = None) -> Any:
+                          plan: Zero3GatherPlan | None = None,
+                          staging_order: str = "inter_intra") -> Any:
     """The unified engine's replacement for the per-leaf non-block
     zero3 gather: pack the shardable non-block leaves into
     [n_inter, n_intra, cols] buckets (scope ``bucket_pack`` — pure
@@ -1694,7 +1695,11 @@ def gather_zero3_bucketed(tree: Any, mesh,
     ``bucket_rs_intra``/``bucket_rs_inter``), and unpack to model
     shapes (scope ``bucket_unpack``). Streamed (block-stack) leaves
     pass through untouched; leaves with no dividing dim gather per leaf
-    under ``zero3_gather`` exactly as the oracle walk does."""
+    under ``zero3_gather`` exactly as the oracle walk does.
+
+    ``target_bytes`` and ``staging_order`` are the tuned-schedule
+    parameters (resolve_bucket_mb / resolve_staging_order over the
+    committed TUNED_* plan; defaults = the hand-set oracle values)."""
     import jax.tree_util as jtu
 
     from dinov3_tpu.parallel.sharding import (
@@ -1728,7 +1733,7 @@ def gather_zero3_bucketed(tree: Any, mesh,
             # the pack as shard-local movement, not a resharding
             rows = jax.lax.with_sharding_constraint(
                 rows, jax.sharding.NamedSharding(mesh, spec))
-        full = hier_gather_bucket(rows, mesh)
+        full = hier_gather_bucket(rows, mesh, staging_order=staging_order)
         with jax.named_scope("bucket_unpack"):
             for m in b.members:
                 seg = full[:, :, m.offset:m.offset + m.cols]
@@ -1744,6 +1749,7 @@ def gather_zero3_bucketed(tree: Any, mesh,
 
 def make_zero3_gather_schedule(
     plan: Zero3GatherPlan, mesh, bucketed: bool = True,
+    staging_order: str = "inter_intra",
 ) -> Callable:
     """The unified gather phase with EXPLICIT collectives — the
     ``make_bucketed_update_schedule`` convention applied to the zero3
@@ -1771,12 +1777,20 @@ def make_zero3_gather_schedule(
     (scope ``zero3_gather``), whose built-in transpose is one
     ``psum_scatter`` per grad leaf — the collective set the bucket arm
     collapses.
+
+    ``staging_order`` ("<ag>_<rs>", parallel/sharding.py
+    ``split_staging_order``) picks which tier each direction releases
+    first — the tuner's A/B axis (scripts/tune_collectives.py). The
+    gathered values are bitwise order-invariant (pure movement); the
+    backward's partial-sum tree permutes across tiers, so RS-order
+    candidates match to reduction tolerance.
     """
     import jax.tree_util as jtu
 
     from dinov3_tpu.parallel.context import shard_map_compat
     from dinov3_tpu.parallel.sharding import (
         hierarchy_axes,
+        split_staging_order,
         update_shard_size,
     )
     from jax.sharding import PartitionSpec as P
@@ -1794,15 +1808,22 @@ def make_zero3_gather_schedule(
     inter, intra = hierarchy_axes(mesh)
     axes = inter + intra
     n_inter, n_intra = plan.n_inter, plan.n_intra
+    ag_first, rs_first = split_staging_order(staging_order)
 
     def _staged_ag(row):
         # [cols] shard row -> replicated [n_inter, n_intra, cols]
-        with jax.named_scope("bucket_ag_inter"):
-            g = (jax.lax.all_gather(row, inter, tiled=False)
-                 if inter else row[None])
+        if ag_first == "inter":
+            with jax.named_scope("bucket_ag_inter"):
+                g = (jax.lax.all_gather(row, inter, tiled=False)
+                     if inter else row[None])
+            with jax.named_scope("bucket_ag_intra"):
+                g = jax.lax.all_gather(g, intra, tiled=False)
+            return jnp.swapaxes(g, 0, 1)
         with jax.named_scope("bucket_ag_intra"):
-            g = jax.lax.all_gather(g, intra, tiled=False)
-        return jnp.swapaxes(g, 0, 1)
+            g = jax.lax.all_gather(row, intra, tiled=False)
+        with jax.named_scope("bucket_ag_inter"):
+            return (jax.lax.all_gather(g, inter, tiled=False)
+                    if inter else g[None])
 
     @jax.custom_vjp
     def staged_gather(row):
@@ -1813,15 +1834,25 @@ def make_zero3_gather_schedule(
 
     def _bwd(_, ct):
         # replicated [n_inter, n_intra, cols] cotangent -> this
-        # device's [cols] grad shard: tier-for-tier mirror of the
-        # forward, intra reduce-scatter first
-        with jax.named_scope("bucket_rs_intra"):
-            r = jax.lax.psum_scatter(
-                ct, intra, scatter_dimension=1, tiled=False)
+        # device's [cols] grad shard, per staging_order's RS half (the
+        # default mirrors the forward tier for tier: intra
+        # reduce-scatter first)
+        if rs_first == "intra":
+            with jax.named_scope("bucket_rs_intra"):
+                r = jax.lax.psum_scatter(
+                    ct, intra, scatter_dimension=1, tiled=False)
+            with jax.named_scope("bucket_rs_inter"):
+                r = (jax.lax.psum_scatter(
+                    r, inter, scatter_dimension=0, tiled=False)
+                    if inter else r[0])
+            return (r,)
         with jax.named_scope("bucket_rs_inter"):
             r = (jax.lax.psum_scatter(
-                r, inter, scatter_dimension=0, tiled=False)
-                if inter else r[0])
+                ct, inter, scatter_dimension=0, tiled=False)
+                if inter else ct[0])
+        with jax.named_scope("bucket_rs_intra"):
+            r = jax.lax.psum_scatter(
+                r, intra, scatter_dimension=0, tiled=False)
         return (r,)
 
     staged_gather.defvjp(_fwd, _bwd)

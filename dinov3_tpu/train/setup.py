@@ -329,8 +329,15 @@ def _build_train_setup(
                 patch_embed_lr_mult=cfg.optim.patch_embed_lr_mult,
                 dino_head_wd_multiplier=cfg.optim.dino_head_wd_multiplier,
             )
-            target_bytes = int(
-                (cfg.get("optim") or {}).get("bucket_mb", 128)) * 2 ** 20
+            from dinov3_tpu.configs.config import (
+                live_tuned_fingerprint,
+                resolve_bucket_mb,
+            )
+
+            target_bytes = resolve_bucket_mb(
+                (cfg.get("optim") or {}).get("bucket_mb", "auto"),
+                live=live_tuned_fingerprint(cfg),
+            ) * 2 ** 20
             bucket_plan = make_bucket_plan(
                 abstract_params["student"], dp, is_last_layer=is_last,
                 target_bytes=target_bytes,
@@ -481,8 +488,16 @@ def _build_train_setup(
         from dinov3_tpu.configs.config import warn_seq_padding
         from dinov3_tpu.ops.attention import RING_MIN_SEQ
 
+        from dinov3_tpu.configs.config import (
+            live_tuned_fingerprint,
+            resolve_ring_min_seq,
+        )
+
         kernels = cfg.get("kernels") or {}
-        ring_min = int(kernels.get("ring_min_seq", 0) or 0) or RING_MIN_SEQ
+        ring_min = resolve_ring_min_seq(
+            kernels.get("ring_min_seq", 0),
+            live=live_tuned_fingerprint(cfg),
+        ) or RING_MIN_SEQ
         n_prefix = 1 + int(cfg.student.get("n_storage_tokens", 0) or 0)
         patch = int(cfg.student.patch_size)
         crops = cfg.get("crops") or {}

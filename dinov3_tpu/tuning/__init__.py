@@ -1,0 +1,50 @@
+"""Anatomy-driven collective auto-tuner: the measure->tune loop.
+
+``scripts/tune_collectives.py`` runs a few profiled steps per
+candidate, reads the step-anatomy ledger's per-scope exposed/
+overlapped columns (telemetry/anatomy.py), searches the schedule
+knobs (optim.bucket_mb, optim.staging_order, optim.stream_prefetch,
+kernels.ring_min_seq), and commits the winning plan + full
+measurement trail as ``TUNED_r20.json``; "auto" on those knobs then
+resolves from the artifact (configs/config.py resolve_* family) with
+a fingerprint check and a loud hand-set fallback.
+
+- ``plan``: artifact schema, ``select_best`` re-derivable selection,
+  validation, and the per-knob provenance bench.py embeds.
+- ``search``: candidate spaces + the generic sweep/derive drivers.
+- ``census``: the no-silent-knobs registry over optim.*/kernels.*.
+"""
+
+from dinov3_tpu.tuning.census import (
+    CENSUS_SECTIONS,
+    KNOB_REGISTRY,
+    knob_census,
+)
+from dinov3_tpu.tuning.plan import (
+    FINGERPRINT_KEYS,
+    KNOBS,
+    TUNED_SCHEMA,
+    knob_entry,
+    load_tuned_plan,
+    select_best,
+    tuned_plan_provenance,
+    validate_plan,
+)
+from dinov3_tpu.tuning.search import (
+    BUCKET_MB_CANDIDATES,
+    RING_MIN_SEQ_CANDIDATES,
+    STREAM_PREFETCH_CANDIDATES,
+    derive_ring_trail,
+    staging_order_candidates,
+    sweep_knob,
+    trail_row,
+)
+
+__all__ = [
+    "BUCKET_MB_CANDIDATES", "CENSUS_SECTIONS", "FINGERPRINT_KEYS",
+    "KNOBS", "KNOB_REGISTRY", "RING_MIN_SEQ_CANDIDATES",
+    "STREAM_PREFETCH_CANDIDATES", "TUNED_SCHEMA", "derive_ring_trail",
+    "knob_census", "knob_entry", "load_tuned_plan", "select_best",
+    "staging_order_candidates", "sweep_knob", "trail_row",
+    "tuned_plan_provenance", "validate_plan",
+]

@@ -1,0 +1,209 @@
+"""Tuned collective-schedule plan artifact: schema, deterministic
+selection, and provenance (the measure->tune loop's committed half).
+
+The tuner (scripts/tune_collectives.py) measures a few profiled steps
+per candidate through the step-anatomy plane (telemetry/anatomy.py) and
+writes ONE committed ``TUNED_*.json`` keyed by the setup fingerprint
+(arch, device count, update-shard size, jax version). This module owns
+everything about that artifact that is NOT measurement:
+
+- ``select_best``: the deterministic argmin over a measurement trail —
+  first candidate achieving the minimal objective wins, so ``chosen``
+  is re-derivable from the committed trail by anyone (the
+  tests/test_tuning.py pin, and the reason "auto" resolution is
+  bitwise-deterministic: same artifact bytes -> same knob values).
+- ``validate_plan`` / ``load_tuned_plan``: schema enforcement — every
+  knob entry must carry its full per-candidate trail, its hand-set
+  oracle value, and a ``chosen`` equal to ``select_best(trail)``.
+- ``tuned_plan_provenance``: the per-knob resolution record bench.py
+  embeds in every record (configured value, resolved value, and which
+  path produced it: explicit / tuned / fallback), so a benched number
+  can always be traced to the exact schedule that produced it.
+
+Objective (telemetry/anatomy.py ``tuning_summary``):
+``objective_ms = step_wall_ms.mean + exposed_comm_ms_per_step`` —
+exposed collective time is paid once inside the wall and once more as
+the penalty term, so two candidates with equal walls prefer the one
+hiding more of its communication (the one with headroom on hardware
+where compute and comm genuinely overlap; see docs/PERFORMANCE.md).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+from dinov3_tpu.configs.config import (
+    TUNED_ARTIFACT,
+    TUNED_FALLBACKS,
+    tuned_fingerprint_mismatches,
+)
+
+TUNED_SCHEMA = "tuned-plan/v1"
+
+# the full knob set a complete plan carries (the committed artifact is
+# pinned to exactly this set; --smoke plans may carry a subset)
+KNOBS = ("bucket_mb", "staging_order", "stream_prefetch", "ring_min_seq")
+
+FINGERPRINT_KEYS = ("arch", "device_count", "update_shard_size", "jax")
+
+
+def select_best(trail: list) -> Any:
+    """Deterministic winner of a measurement trail: the FIRST candidate
+    achieving the minimal ``objective_ms`` (strict-< scan, so ties go
+    to the earlier row — candidate order is part of the artifact and
+    the scan is reproducible from the committed floats alone)."""
+    if not trail:
+        raise ValueError("empty measurement trail")
+    best = trail[0]
+    for row in trail[1:]:
+        if float(row["objective_ms"]) < float(best["objective_ms"]):
+            best = row
+    return best["value"]
+
+
+def knob_entry(trail: list, knob: str, program: str,
+               unit: str | None = None, extra: dict | None = None) -> dict:
+    """Assemble one knob's artifact entry from its measurement trail.
+    ``chosen`` is computed here, AFTER the caller rounded the trail
+    (telemetry.anatomy.round_floats), so re-deriving it from the
+    committed floats gives the same winner."""
+    entry = {
+        "chosen": select_best(trail),
+        "handset": TUNED_FALLBACKS[knob],
+        "program": program,
+        "trail": trail,
+    }
+    if unit:
+        entry["unit"] = unit
+    if extra:
+        entry.update(extra)
+    return entry
+
+
+def validate_plan(doc: dict) -> dict:
+    """Raise ValueError on any schema violation; return the doc.
+
+    Checks: schema tag, complete fingerprint, generated_by, and per
+    knob — a known name, a non-empty trail whose rows carry
+    ``value``/``objective_ms``, a ``handset`` equal to the hand-set
+    oracle (configs/config.py TUNED_FALLBACKS), and ``chosen`` equal
+    to ``select_best(trail)`` (the re-derivability pin)."""
+    if doc.get("schema") != TUNED_SCHEMA:
+        raise ValueError(
+            f"schema {doc.get('schema')!r} != {TUNED_SCHEMA!r}")
+    fp = doc.get("fingerprint") or {}
+    missing = [k for k in FINGERPRINT_KEYS if k not in fp]
+    if missing:
+        raise ValueError(f"fingerprint missing {missing}")
+    if not doc.get("generated_by"):
+        raise ValueError("missing generated_by")
+    knobs = doc.get("knobs") or {}
+    if not knobs:
+        raise ValueError("no knobs")
+    for name, entry in knobs.items():
+        if name not in KNOBS:
+            raise ValueError(f"unknown knob {name!r}")
+        trail = entry.get("trail") or []
+        if not trail:
+            raise ValueError(f"{name}: empty trail")
+        for row in trail:
+            if "value" not in row or "objective_ms" not in row:
+                raise ValueError(f"{name}: trail row missing "
+                                 f"value/objective_ms: {row}")
+        if entry.get("handset") != TUNED_FALLBACKS[name]:
+            raise ValueError(
+                f"{name}: handset {entry.get('handset')!r} != oracle "
+                f"{TUNED_FALLBACKS[name]!r}")
+        if entry.get("chosen") != select_best(trail):
+            raise ValueError(
+                f"{name}: chosen {entry.get('chosen')!r} is not "
+                f"select_best(trail) = {select_best(trail)!r} — the "
+                f"committed winner must be re-derivable from the trail")
+    return doc
+
+
+def load_tuned_plan(path: Path | str | None = None) -> dict:
+    """Read + validate a tuned plan artifact (default: the committed
+    TUNED_ARTIFACT). Raises on unreadable/invalid — callers that want
+    graceful degradation use the config resolvers instead."""
+    p = Path(TUNED_ARTIFACT if path is None else path)
+    with open(p) as f:
+        return validate_plan(json.load(f))
+
+
+def tuned_plan_provenance(
+    cfg, artifact: Path | str | None = None, live: dict | None = None,
+) -> dict:
+    """Per-knob resolution record for bench/telemetry embedding:
+    which value each schedule knob resolved to and WHY (the same
+    decision procedure as the config resolvers, recorded instead of
+    warned). ``source`` per knob is one of:
+
+    - ``explicit``: the config hand-set the knob — the oracle;
+    - ``tuned``: "auto" resolved from the artifact (fingerprint ok);
+    - ``fallback_unreadable``: "auto" but no readable artifact;
+    - ``fallback_stale``: "auto" but the artifact fingerprint
+      mismatches the supplied live fingerprint.
+    """
+    import warnings
+
+    from dinov3_tpu.configs.config import (
+        resolve_bucket_mb,
+        resolve_ring_min_seq,
+        resolve_staging_order,
+        resolve_stream_prefetch,
+    )
+
+    path = Path(TUNED_ARTIFACT if artifact is None else artifact)
+    doc: dict | None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except Exception:  # noqa: BLE001 - recorded, not raised
+        doc = None
+    fp = (doc or {}).get("fingerprint") or {}
+    stale = (tuned_fingerprint_mismatches(fp, live)
+             if (doc is not None and live is not None) else [])
+
+    optim = cfg.get("optim") or {}
+    kernels = cfg.get("kernels") or {}
+    configured = {
+        "bucket_mb": optim.get("bucket_mb", "auto"),
+        "staging_order": optim.get("staging_order", "auto"),
+        "stream_prefetch": optim.get("stream_prefetch", "auto"),
+        "ring_min_seq": kernels.get("ring_min_seq", "auto"),
+    }
+    resolvers = {
+        "bucket_mb": resolve_bucket_mb,
+        "staging_order": resolve_staging_order,
+        "stream_prefetch": resolve_stream_prefetch,
+        "ring_min_seq": resolve_ring_min_seq,
+    }
+    knobs = {}
+    for name, raw in configured.items():
+        auto = raw is None or raw == "" or raw == "auto"
+        if not auto:
+            source = "explicit"
+        elif doc is None:
+            source = "fallback_unreadable"
+        elif stale:
+            source = "fallback_stale"
+        else:
+            source = "tuned"
+        with warnings.catch_warnings():
+            # the provenance record replaces the warning here; the
+            # loud path stays with the actual consumers
+            warnings.simplefilter("ignore")
+            resolved = resolvers[name](raw, artifact=path, live=live)
+        knobs[name] = {"configured": raw, "resolved": resolved,
+                       "source": source}
+    return {
+        "artifact": str(path),
+        "artifact_readable": doc is not None,
+        "fingerprint": fp or None,
+        "fingerprint_live": live,
+        "stale": stale,
+        "knobs": knobs,
+    }

@@ -1,0 +1,109 @@
+"""Search driver for the collective auto-tuner: candidate spaces and
+the generic measured-sweep loop (the measure->tune loop's search half).
+
+The measurement functions themselves live in
+scripts/tune_collectives.py (they own the harness: XLA device-count
+flags before the jax import, profiled trace windows, the arm twins);
+this module owns everything deterministic around them — WHICH values
+to try and HOW a trail of ``tuning_summary`` measurements becomes a
+committed trail (tuning/plan.py ``select_best`` then picks the
+winner from the rounded floats).
+
+Candidate spaces (each includes its hand-set oracle, so the sweep
+always measures the status quo and ``tuned >= handset`` is checkable
+per arm from the same trail):
+
+- ``bucket_mb``: halving/doubling around the hand-set 128 MiB — the
+  latency-vs-overlap-granularity trade of the greedy bucket packing
+  (fewer+bigger buckets amortize collective latency, more+smaller
+  ones pipeline deeper into the backward).
+- ``staging_order``: all four "<ag>_<rs>" tier-release orders of the
+  hierarchy-aware staged gathers (parallel/sharding.py
+  STAGING_ORDERS) — which mesh tier each direction exercises first.
+- ``stream_prefetch``: gather-lookahead depth of the explicit weight
+  streams (0 = at-use, 1 = double buffer, 2 = deeper pipeline).
+- ``ring_min_seq``: the ring-dispatch floor is NOT swept by
+  recompiling the model per floor — ring-vs-dense is measured once
+  per workload token count and every candidate floor's objective is
+  derived deterministically from that committed table
+  (``derive_ring_trail``), the crossover-artifact discipline of
+  resolve_flash_min_seq applied to the ring path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+BUCKET_MB_CANDIDATES = (32, 64, 128, 256)
+STREAM_PREFETCH_CANDIDATES = (0, 1, 2)
+RING_MIN_SEQ_CANDIDATES = (256, 512, 1024, 2048)
+
+TRAIL_FIELDS = ("objective_ms", "step_wall_ms_mean",
+                "exposed_comm_ms_per_step", "exposed_comm_frac")
+
+
+def staging_order_candidates() -> tuple:
+    # lazy: parallel/sharding.py imports jax
+    from dinov3_tpu.parallel.sharding import STAGING_ORDERS
+
+    return STAGING_ORDERS
+
+
+def trail_row(value: Any, tuning: dict, **extra) -> dict:
+    """One trail row from a ``tuning_summary`` dict: the candidate
+    value + the objective decomposition (committed so the winner is
+    re-derivable and the loser margins are auditable)."""
+    row = {"value": value}
+    row.update({k: tuning[k] for k in TRAIL_FIELDS if k in tuning})
+    row.update(extra)
+    return row
+
+
+def sweep_knob(
+    knob: str,
+    candidates,
+    measure_fn: Callable[[Any], dict],
+    log: Callable[[str], None] | None = None,
+) -> list:
+    """Measure every candidate through ``measure_fn`` (value ->
+    ``tuning_summary`` dict) and return the full trail, in candidate
+    order. No selection here — ``plan.select_best`` runs over the
+    ROUNDED committed floats so artifact readers re-derive the same
+    winner."""
+    trail = []
+    for value in candidates:
+        tuning = measure_fn(value)
+        row = trail_row(value, tuning)
+        trail.append(row)
+        if log:
+            log(f"{knob}={value!r}: objective "
+                f"{row['objective_ms']:.3f} ms (wall "
+                f"{row['step_wall_ms_mean']:.3f} + exposed "
+                f"{row['exposed_comm_ms_per_step']:.3f})")
+    return trail
+
+
+def derive_ring_trail(workloads: list, candidates=RING_MIN_SEQ_CANDIDATES,
+                      ) -> list:
+    """Per-floor objectives derived from the measured ring-vs-dense
+    workload table: for floor F the dispatch (ops/attention.py) rings
+    every pass with ``tokens >= F`` and runs the rest dense, so
+    ``objective(F) = sum_w (ring if w.tokens >= F else dense)``.
+
+    ``workloads``: ``[{"tokens": N, "ring_objective_ms": r,
+    "dense_objective_ms": d}, ...]`` — measured once per N, floors
+    cost nothing extra, and the derivation is exact arithmetic over
+    committed floats (bitwise re-derivable)."""
+    trail = []
+    for floor in candidates:
+        obj = 0.0
+        split = []
+        for w in workloads:
+            rings = int(w["tokens"]) >= int(floor)
+            obj += float(w["ring_objective_ms"] if rings
+                         else w["dense_objective_ms"])
+            split.append({"tokens": w["tokens"],
+                          "impl": "ring" if rings else "dense"})
+        trail.append({"value": floor, "objective_ms": obj,
+                      "dispatch": split, "derived": True})
+    return trail

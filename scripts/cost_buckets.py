@@ -134,7 +134,10 @@ def update_phase_twins(cfg, dp: int) -> dict:
         patch_embed_lr_mult=cfg.optim.patch_embed_lr_mult,
         dino_head_wd_multiplier=cfg.optim.dino_head_wd_multiplier,
     )
-    target_bytes = int(cfg.optim.get("bucket_mb", 128)) * 2 ** 20
+    from dinov3_tpu.configs.config import resolve_bucket_mb
+
+    target_bytes = resolve_bucket_mb(
+        cfg.optim.get("bucket_mb", "auto")) * 2 ** 20
     plan = make_bucket_plan(student, dp, is_last_layer=isll,
                             target_bytes=target_bytes)
     kw = dict(b1=cfg.optim.adamw_beta1, b2=cfg.optim.adamw_beta2,

@@ -120,7 +120,10 @@ def gather_phase_twins(cfg, mesh) -> dict:
         lambda r: meta.init_params(r, batch), jax.random.key(0)
     )["student"]
     subtree = _prune_streamed(student)
-    target_bytes = int(cfg.optim.get("bucket_mb", 128)) * 2 ** 20
+    from dinov3_tpu.configs.config import resolve_bucket_mb
+
+    target_bytes = resolve_bucket_mb(
+        cfg.optim.get("bucket_mb", "auto")) * 2 ** 20
     plan = make_zero3_bucket_plan(subtree, mesh, target_bytes=target_bytes)
 
     def shardings(tree):

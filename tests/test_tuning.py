@@ -1,0 +1,688 @@
+"""Anatomy-driven collective auto-tuner (ISSUE 16): artifact pins,
+resolver semantics, knob census, the tuned-vs-handset gate, and the
+schedule-knob equivalences the tuner's candidate axes rely on.
+
+Pinned here:
+
+- the committed ``TUNED_r20.json`` plan validates, carries every knob
+  with its full measurement trail, and every ``chosen`` (including the
+  derived ring floor) is re-derivable from the committed floats alone
+  (tuning/plan.py ``select_best`` / tuning/search.py
+  ``derive_ring_trail``) — the artifact never asks to be trusted;
+- the "auto" resolvers (configs/config.py resolve_bucket_mb /
+  resolve_staging_order / resolve_stream_prefetch /
+  resolve_ring_min_seq): explicit values pass through untouched (the
+  hand-set oracle), "auto" reads the artifact bitwise-
+  deterministically, and unreadable/stale artifacts warn loudly and
+  fall back to the exact pre-tuner constants;
+- ``warn_tuned_plan_stale``'s dual modes and the knob census's
+  no-silent-knobs guarantee (tuning/census.py);
+- ``perf_gate.tuned_vs_handset``: the committed plan is never worse
+  than the hand-set schedule on any arm, and a perturbed plan fails;
+- candidate-axis equivalences: every stream-prefetch depth and every
+  staging order computes the SAME numbers (they are pure wire
+  schedules), so the tuner is free to pick any of them on latency
+  alone.
+"""
+
+import copy
+import importlib.util
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinov3_tpu.configs import apply_dot_overrides, get_default_config
+from dinov3_tpu.configs.config import (
+    TUNED_ARTIFACT,
+    TUNED_FALLBACKS,
+    resolve_bucket_mb,
+    resolve_ring_min_seq,
+    resolve_staging_order,
+    resolve_stream_prefetch,
+    tuned_fingerprint_mismatches,
+    warn_tuned_plan_stale,
+)
+from dinov3_tpu.parallel.mesh import MeshSpec, build_mesh
+from dinov3_tpu.tuning import (
+    KNOBS,
+    TUNED_SCHEMA,
+    derive_ring_trail,
+    knob_census,
+    load_tuned_plan,
+    select_best,
+    sweep_knob,
+    trail_row,
+    tuned_plan_provenance,
+    validate_plan,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# a live fingerprint that MATCHES the fake artifact below (not the
+# committed one — these tests never depend on the committed tuning)
+def _fake_live():
+    return {"arch": "vit_test", "device_count": 8,
+            "update_shard_size": 8, "jax": jax.__version__}
+
+
+def _fake_doc():
+    return {
+        "schema": TUNED_SCHEMA,
+        "generated_by": "test",
+        "fingerprint": _fake_live(),
+        "knobs": {
+            "bucket_mb": {
+                "chosen": 64, "handset": TUNED_FALLBACKS["bucket_mb"],
+                "program": "test",
+                "trail": [{"value": 64, "objective_ms": 1.0},
+                          {"value": 128, "objective_ms": 2.0}]},
+            "staging_order": {
+                "chosen": "intra_inter",
+                "handset": TUNED_FALLBACKS["staging_order"],
+                "program": "test",
+                "trail": [{"value": "inter_intra", "objective_ms": 2.0},
+                          {"value": "intra_inter", "objective_ms": 1.0}]},
+            "stream_prefetch": {
+                "chosen": 2, "handset": TUNED_FALLBACKS["stream_prefetch"],
+                "program": "test",
+                "trail": [{"value": 1, "objective_ms": 2.0},
+                          {"value": 2, "objective_ms": 1.0}]},
+            "ring_min_seq": {
+                "chosen": 512, "handset": TUNED_FALLBACKS["ring_min_seq"],
+                "program": "test",
+                "trail": [{"value": 512, "objective_ms": 1.0},
+                          {"value": 1024, "objective_ms": 2.0}]},
+        },
+    }
+
+
+@pytest.fixture
+def fake_artifact(tmp_path):
+    p = tmp_path / "TUNED_fake.json"
+    p.write_text(json.dumps(validate_plan(_fake_doc())))
+    return p
+
+
+# ---------------- pure selection / derivation ----------------
+
+def test_select_best_first_minimal_ties_to_earlier():
+    trail = [{"value": "a", "objective_ms": 2.0},
+             {"value": "b", "objective_ms": 1.5},
+             {"value": "c", "objective_ms": 1.5}]
+    assert select_best(trail) == "b"  # strict-< scan: tie -> earlier
+    with pytest.raises(ValueError):
+        select_best([])
+
+
+def test_sweep_knob_preserves_candidate_order_and_fields():
+    calls = []
+
+    def measure(v):
+        calls.append(v)
+        return {"objective_ms": float(10 - v),
+                "step_wall_ms_mean": float(v),
+                "exposed_comm_ms_per_step": 0.5,
+                "exposed_comm_frac": 0.1}
+
+    trail = sweep_knob("k", (1, 2, 3), measure)
+    assert calls == [1, 2, 3]
+    assert [r["value"] for r in trail] == [1, 2, 3]
+    assert all("objective_ms" in r and "exposed_comm_frac" in r
+               for r in trail)
+    assert trail_row(7, {"objective_ms": 1.0}, derived=True) == {
+        "value": 7, "objective_ms": 1.0, "derived": True}
+
+
+def test_derive_ring_trail_is_exact_arithmetic():
+    workloads = [
+        {"tokens": 256, "ring_objective_ms": 5.0,
+         "dense_objective_ms": 3.0},
+        {"tokens": 1024, "ring_objective_ms": 7.0,
+         "dense_objective_ms": 11.0},
+    ]
+    trail = derive_ring_trail(workloads, candidates=(256, 512, 2048))
+    by_floor = {r["value"]: r for r in trail}
+    # floor 256: both workloads ring -> 5 + 7
+    assert by_floor[256]["objective_ms"] == 12.0
+    # floor 512: 256 dense, 1024 rings -> 3 + 7 (the winner here)
+    assert by_floor[512]["objective_ms"] == 10.0
+    # floor 2048: everything dense -> 3 + 11
+    assert by_floor[2048]["objective_ms"] == 14.0
+    assert select_best(trail) == 512
+    assert all(r["derived"] for r in trail)
+    assert by_floor[512]["dispatch"] == [
+        {"tokens": 256, "impl": "dense"}, {"tokens": 1024, "impl": "ring"}]
+
+
+def test_validate_plan_catches_violations():
+    validate_plan(_fake_doc())  # the well-formed baseline passes
+    bad = _fake_doc()
+    bad["schema"] = "nope/v0"
+    with pytest.raises(ValueError, match="schema"):
+        validate_plan(bad)
+    bad = _fake_doc()
+    del bad["fingerprint"]["arch"]
+    with pytest.raises(ValueError, match="fingerprint"):
+        validate_plan(bad)
+    bad = _fake_doc()
+    bad["knobs"]["bucket_mb"]["chosen"] = 128  # not select_best(trail)
+    with pytest.raises(ValueError, match="re-derivable"):
+        validate_plan(bad)
+    bad = _fake_doc()
+    bad["knobs"]["bucket_mb"]["handset"] = 999  # not the oracle
+    with pytest.raises(ValueError, match="oracle"):
+        validate_plan(bad)
+    bad = _fake_doc()
+    bad["knobs"]["mystery"] = bad["knobs"].pop("bucket_mb")
+    with pytest.raises(ValueError, match="unknown knob"):
+        validate_plan(bad)
+
+
+# ---------------- the committed artifact ----------------
+
+def test_committed_plan_valid_and_complete():
+    """TUNED_r20.json: validates, carries the FULL knob set with
+    measurement trails, and was tuned on the 8-device ViT-L setup the
+    fingerprint claims."""
+    doc = load_tuned_plan()  # validate_plan already ran
+    assert set(doc["knobs"]) == set(KNOBS)
+    fp = doc["fingerprint"]
+    assert fp["arch"] == "vit_large"
+    assert fp["device_count"] == 8
+    assert doc["generated_by"] == "scripts/tune_collectives.py"
+    # every trail row commits the objective decomposition (derived
+    # ring rows commit the dispatch split instead)
+    for name, entry in doc["knobs"].items():
+        assert len(entry["trail"]) >= 2, f"{name}: no search happened"
+        for row in entry["trail"]:
+            assert "objective_ms" in row
+            assert "step_wall_ms_mean" in row or row.get("derived"), (
+                f"{name}: measured row missing its decomposition")
+
+
+def test_committed_chosen_rederivable_from_trails():
+    doc = load_tuned_plan()
+    for name, entry in doc["knobs"].items():
+        assert entry["chosen"] == select_best(entry["trail"]), name
+        assert entry["handset"] == TUNED_FALLBACKS[name], name
+
+
+def test_committed_ring_trail_rederivable_from_workloads():
+    """The ring floor's whole trail is arithmetic over the committed
+    ring-vs-dense workload table — re-derive it and compare."""
+    from dinov3_tpu.telemetry.anatomy import round_floats
+
+    doc = load_tuned_plan()
+    entry = doc["knobs"]["ring_min_seq"]
+    workloads = entry["workloads"]
+    assert len(workloads) >= 2
+    floors = tuple(r["value"] for r in entry["trail"])
+    redone = round_floats(derive_ring_trail(
+        [{"tokens": w["tokens"],
+          "ring_objective_ms": w["ring_objective_ms"],
+          "dense_objective_ms": w["dense_objective_ms"]}
+         for w in workloads], candidates=floors))
+    committed = [{"value": r["value"], "objective_ms": r["objective_ms"],
+                  "dispatch": r["dispatch"], "derived": r["derived"]}
+                 for r in entry["trail"]]
+    assert redone == committed
+
+
+def test_committed_plan_resolves_bitwise_deterministically():
+    """Two resolutions of every auto knob from the committed artifact
+    are identical — and equal to the committed chosen values (matching
+    live fingerprint)."""
+    doc = load_tuned_plan()
+    live = dict(doc["fingerprint"])  # live == tuned -> no fallback
+    resolvers = {
+        "bucket_mb": resolve_bucket_mb,
+        "staging_order": resolve_staging_order,
+        "stream_prefetch": resolve_stream_prefetch,
+        "ring_min_seq": resolve_ring_min_seq,
+    }
+    for name, resolve in resolvers.items():
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # no fallback warning allowed
+            a = resolve("auto", live=live)
+            b = resolve("auto", live=live)
+        assert a == b == doc["knobs"][name]["chosen"], name
+
+
+# ---------------- resolver semantics ----------------
+
+def test_resolvers_explicit_passthrough_is_the_oracle():
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # explicit values never warn
+        assert resolve_bucket_mb(64) == 64
+        assert resolve_bucket_mb("96") == 96
+        assert resolve_ring_min_seq(0) == 0  # the ops-layer sentinel
+        assert resolve_staging_order("intra_inter") == "intra_inter"
+        assert resolve_stream_prefetch(0) == 0
+        assert resolve_stream_prefetch(2) == 2
+
+
+def test_resolvers_auto_read_artifact(fake_artifact):
+    live = _fake_live()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert resolve_bucket_mb(
+            "auto", artifact=fake_artifact, live=live) == 64
+        assert resolve_staging_order(
+            "auto", artifact=fake_artifact, live=live) == "intra_inter"
+        assert resolve_stream_prefetch(
+            "auto", artifact=fake_artifact, live=live) == 2
+        assert resolve_ring_min_seq(
+            "auto", artifact=fake_artifact, live=live) == 512
+        # None/"" normalize to "auto" (yaml null, empty override)
+        assert resolve_bucket_mb(
+            None, artifact=fake_artifact, live=live) == 64
+
+
+def test_resolvers_unreadable_artifact_falls_back_loudly(tmp_path):
+    gone = tmp_path / "nope.json"
+    with pytest.warns(UserWarning, match="unreadable"):
+        assert resolve_bucket_mb("auto", artifact=gone) == 128
+    with pytest.warns(UserWarning, match="unreadable"):
+        assert resolve_ring_min_seq("auto", artifact=gone) == 1024
+    with pytest.warns(UserWarning, match="unreadable"):
+        assert resolve_staging_order(
+            "auto", artifact=gone) == "inter_intra"
+    with pytest.warns(UserWarning, match="unreadable"):
+        assert resolve_stream_prefetch("auto", artifact=gone) == 1
+    # a partial artifact (readable json, missing the knob) degrades the
+    # same way — never a crash
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps({"knobs": {}}))
+    with pytest.warns(UserWarning, match="unreadable"):
+        assert resolve_bucket_mb("auto", artifact=partial) == 128
+
+
+def test_resolvers_stale_fingerprint_falls_back_loudly(fake_artifact):
+    live = _fake_live()
+    live["arch"] = "vit_large"  # artifact was "tuned" for vit_test
+    with pytest.warns(UserWarning, match="different setup"):
+        assert resolve_bucket_mb(
+            "auto", artifact=fake_artifact, live=live) == 128
+    with pytest.warns(UserWarning, match="different setup"):
+        assert resolve_stream_prefetch(
+            "auto", artifact=fake_artifact, live=live) == 1
+    # without a live fingerprint there is nothing to compare: the
+    # artifact applies (the config-load path stays device-free)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert resolve_bucket_mb("auto", artifact=fake_artifact) == 64
+
+
+def test_resolvers_reject_invalid_explicit_values():
+    with pytest.raises(ValueError):
+        resolve_staging_order("sideways_inter")
+    with pytest.raises(ValueError):
+        resolve_stream_prefetch(-1)
+
+
+def test_fingerprint_mismatch_semantics():
+    fp = _fake_live()
+    assert tuned_fingerprint_mismatches(fp, dict(fp)) == []
+    # jax compares at major.minor: a patch bump is not staleness
+    live = dict(fp)
+    live["jax"] = ".".join(jax.__version__.split(".")[:2]) + ".999"
+    assert tuned_fingerprint_mismatches(fp, live) == []
+    live = dict(fp, device_count=256)
+    bad = tuned_fingerprint_mismatches(fp, live)
+    assert len(bad) == 1 and "device_count" in bad[0]
+
+
+# ---------------- warn_tuned_plan_stale ----------------
+
+def _cfg_with(overrides):
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, overrides)
+    return cfg
+
+
+def test_warn_stale_silent_when_all_knobs_handset(fake_artifact):
+    cfg = _cfg_with([
+        "optim.bucket_mb=128", "optim.staging_order=inter_intra",
+        "optim.stream_prefetch=1", "kernels.ring_min_seq=1024"])
+    live = {"arch": "other", "device_count": 1,
+            "update_shard_size": 1, "jax": jax.__version__}
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert warn_tuned_plan_stale(
+            cfg, live=live, artifact=fake_artifact) is None
+
+
+def test_warn_stale_names_the_mismatched_axes(fake_artifact):
+    cfg = get_default_config()  # schedule knobs default to "auto"
+    live = _fake_live()
+    live.update(arch="vit_large", device_count=256)
+    with pytest.warns(UserWarning) as rec:
+        msg = warn_tuned_plan_stale(cfg, live=live,
+                                    artifact=fake_artifact)
+    assert msg is not None and msg in str(rec[0].message)
+    assert "arch" in msg and "device_count" in msg
+    assert "bucket_mb" in msg  # names the auto knobs that fall back
+    # matching live: silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert warn_tuned_plan_stale(
+            cfg, live=_fake_live(), artifact=fake_artifact) is None
+
+
+def test_warn_stale_without_live_checks_wellformedness(tmp_path,
+                                                      fake_artifact):
+    cfg = get_default_config()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert warn_tuned_plan_stale(cfg, artifact=fake_artifact) is None
+    maimed = tmp_path / "nofp.json"
+    doc = _fake_doc()
+    del doc["fingerprint"]["update_shard_size"]
+    maimed.write_text(json.dumps(doc))
+    with pytest.warns(UserWarning, match="update_shard_size"):
+        assert warn_tuned_plan_stale(cfg, artifact=maimed) is not None
+
+
+def test_committed_artifact_fingerprint_wellformed():
+    cfg = get_default_config()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert warn_tuned_plan_stale(cfg, artifact=TUNED_ARTIFACT) is None
+
+
+# ---------------- knob census ----------------
+
+def test_knob_census_green_on_default_config():
+    res = knob_census()
+    assert res["ok"], (res["unregistered"], res["stale_registry"])
+    assert res["n_knobs"] >= 20
+    assert set(res["by_kind"]["tuned"]) == {
+        "optim.bucket_mb", "optim.staging_order",
+        "optim.stream_prefetch", "kernels.ring_min_seq"}
+    assert "kernels.flash_min_seq" in res["by_kind"]["crossover"]
+
+
+def test_knob_census_catches_unregistered_magic_number():
+    cfg = get_default_config()
+    shadow = {
+        "optim": {k: cfg.optim.get(k) for k in cfg.optim},
+        "kernels": {k: cfg.kernels.get(k) for k in cfg.kernels},
+    }
+    shadow["optim"]["mystery_latency_knob"] = 7
+    res = knob_census(shadow)
+    assert not res["ok"]
+    assert any(u["knob"] == "optim.mystery_latency_knob"
+               for u in res["unregistered"])
+    # bools are mode switches, not magnitudes: never censused
+    shadow["optim"].pop("mystery_latency_knob")
+    shadow["optim"]["mystery_toggle"] = True
+    assert knob_census(shadow)["ok"]
+
+
+def test_knob_census_catches_stale_registry_entry():
+    cfg = get_default_config()
+    shadow = {
+        "optim": {k: cfg.optim.get(k) for k in cfg.optim
+                  if k != "bucket_mb"},  # "renamed away" a tuned knob
+        "kernels": {k: cfg.kernels.get(k) for k in cfg.kernels},
+    }
+    res = knob_census(shadow)
+    assert not res["ok"]
+    assert "optim.bucket_mb" in res["stale_registry"]
+
+
+# ---------------- perf gate: tuned vs hand-set ----------------
+
+def test_perf_gate_tuned_vs_handset_committed_plan_passes():
+    pg = _load_script("perf_gate")
+    doc = load_tuned_plan()
+    res = pg.tuned_vs_handset(doc)
+    assert res["passed"], json.dumps(res, indent=1)
+    assert res["n_arms"] == len(doc["arms"])
+    assert "plan-invariant" in res["arm_notes"].get("replicated", "")
+
+
+def test_perf_gate_tuned_vs_handset_catches_regression():
+    pg = _load_script("perf_gate")
+    doc = copy.deepcopy(load_tuned_plan())
+    # a "tuned" plan 50% slower than hand-set on one arm must fail
+    anat = doc["arms"]["bucketed"]["tuned"]["anatomy"]
+    anat["step_wall_ms"]["mean"] *= 1.5
+    res = pg.tuned_vs_handset(doc)
+    assert not res["passed"]
+    assert any(c["arm"] == "bucketed" and "FAIL" in c["status"]
+               for c in res["checks"])
+
+
+def test_perf_gate_tuned_vs_handset_catches_objective_regression():
+    pg = _load_script("perf_gate")
+    doc = copy.deepcopy(load_tuned_plan())
+    anat = doc["arms"]["bucketed"]["tuned"]["anatomy"]
+    anat["objective_ms"] *= 1.5
+    res = pg.tuned_vs_handset(doc)
+    assert not res["passed"]
+    assert any(c["arm"] == "bucketed" and c["metric"] == "objective_ms"
+               and "FAIL" in c["status"] for c in res["checks"])
+
+
+def test_perf_gate_tuned_vs_handset_ignores_fraction_rise():
+    # a schedule that halves the wall while shrinking exposed ms RAISES
+    # exposed_comm_frac (smaller denominator) — the cross-revision
+    # fraction gate would fail exactly this win; tuned-vs-handset must
+    # pass it (step wall and objective both improved).
+    pg = _load_script("perf_gate")
+    doc = copy.deepcopy(load_tuned_plan())
+    anat = doc["arms"]["bucketed"]["tuned"]["anatomy"]
+    hand = doc["arms"]["bucketed"]["handset"]["anatomy"]
+    anat["step_wall_ms"] = dict(hand["step_wall_ms"],
+                                mean=hand["step_wall_ms"]["mean"] * 0.5)
+    anat["exposed_comm_ms_per_step"] = (
+        hand["exposed_comm_ms_per_step"] * 0.7)
+    anat["objective_ms"] = (anat["step_wall_ms"]["mean"]
+                            + anat["exposed_comm_ms_per_step"])
+    anat["exposed_comm_frac"] = min(
+        1.0, hand["exposed_comm_frac"] + 0.30)  # fraction jumps anyway
+    res = pg.tuned_vs_handset(doc)
+    assert all("FAIL" not in c["status"] for c in res["checks"]
+               if c["arm"] == "bucketed"), json.dumps(res, indent=1)
+
+
+# ---------------- provenance (the bench.py embedding) ----------------
+
+def test_provenance_source_classification(fake_artifact, tmp_path):
+    live = _fake_live()
+    cfg = {"optim": {"bucket_mb": 96, "staging_order": "auto",
+                     "stream_prefetch": "auto"},
+           "kernels": {"ring_min_seq": "auto"}}
+    prov = tuned_plan_provenance(cfg, artifact=fake_artifact, live=live)
+    assert prov["artifact_readable"] and not prov["stale"]
+    k = prov["knobs"]
+    assert k["bucket_mb"] == {"configured": 96, "resolved": 96,
+                              "source": "explicit"}
+    assert k["staging_order"]["source"] == "tuned"
+    assert k["staging_order"]["resolved"] == "intra_inter"
+    assert k["ring_min_seq"] == {"configured": "auto", "resolved": 512,
+                                 "source": "tuned"}
+    # stale live: every auto knob falls back, labelled as such
+    stale_live = dict(live, arch="vit_giant")
+    prov = tuned_plan_provenance(cfg, artifact=fake_artifact,
+                                 live=stale_live)
+    assert prov["stale"]
+    assert k_src(prov, "stream_prefetch") == "fallback_stale"
+    assert prov["knobs"]["stream_prefetch"]["resolved"] == 1
+    assert k_src(prov, "bucket_mb") == "explicit"  # explicit unaffected
+    # unreadable artifact
+    prov = tuned_plan_provenance(cfg, artifact=tmp_path / "gone.json",
+                                 live=live)
+    assert not prov["artifact_readable"]
+    assert k_src(prov, "ring_min_seq") == "fallback_unreadable"
+    assert prov["knobs"]["ring_min_seq"]["resolved"] == 1024
+
+
+def k_src(prov, name):
+    return prov["knobs"][name]["source"]
+
+
+# ---------------- candidate-axis equivalences ----------------
+
+def _stream_fixture():
+    import flax.linen as nn
+
+    from dinov3_tpu.models.streaming import (
+        cast_stream_leaves,
+        make_block_apply,
+    )
+    from dinov3_tpu.ops.block import SelfAttentionBlock
+    from dinov3_tpu.parallel.context import set_current_mesh
+    from dinov3_tpu.parallel.sharding import zero3_leaf_spec
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = build_mesh(MeshSpec(data=8), devices=jax.devices())
+    set_current_mesh(mesh)
+    kwargs = dict(dim=32, num_heads=2, ffn_ratio=2.0,
+                  drop_path_rate=0.0, dtype=jnp.float32)
+    L, N, D = 4, 9, 32
+    block = SelfAttentionBlock(**kwargs)
+    one = nn.meta.unbox(
+        block.init(jax.random.key(0), jnp.zeros((1, N, D), jnp.float32))
+    )["params"]
+    stack = jax.tree.map(
+        lambda p: jnp.stack([p + 0.01 * i for i in range(L)]), one)
+    stack = cast_stream_leaves(stack, jnp.float32)
+
+    def sh(p):
+        spec = zero3_leaf_spec(
+            p.shape, ("layers",) + (None,) * (p.ndim - 1), mesh)
+        return NamedSharding(mesh, spec if spec is not None else P())
+
+    stack_sh = jax.tree.map(sh, stack)
+    x = jax.random.normal(jax.random.key(1), (16, N, D), jnp.float32)
+    return (mesh, jax.device_put(stack, stack_sh), stack_sh,
+            jax.device_put(x, NamedSharding(mesh, P("data"))),
+            NamedSharding(mesh, P("data")), L, make_block_apply(kwargs))
+
+
+def test_stream_prefetch_depths_bitwise_equivalent():
+    """Every lookahead depth (and the legacy booleans) computes the
+    SAME forward bitwise — depth is purely a gather schedule, which is
+    exactly why the tuner may pick any of 0/1/2 on latency alone."""
+    from dinov3_tpu.models.streaming import (
+        prefetch_depth,
+        streamed_block_scan,
+    )
+
+    assert (prefetch_depth(False), prefetch_depth(True)) == (0, 1)
+    assert (prefetch_depth(0), prefetch_depth(1), prefetch_depth(3)) \
+        == (0, 1, 3)
+
+    mesh, stack, stack_sh, x, x_sh, L, apply_fn = _stream_fixture()
+    outs = []
+    with mesh:
+        for depth in (False, 0, True, 1, 2, 3):
+            outs.append(np.asarray(jax.jit(
+                lambda s, xx, d=depth: streamed_block_scan(
+                    apply_fn, s, xx, L, mesh, prefetch=d),
+                in_shardings=(stack_sh, x_sh))(stack, x)))
+    for o in outs[1:]:
+        assert np.array_equal(outs[0], o)
+
+
+def test_bucketed_stream_prefetch_and_orders_bitwise(eight_devices):
+    """bucketed_stream_scan: every prefetch depth AND every staging
+    order of the hierarchical gather path is bitwise the flat
+    double-buffered baseline."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dinov3_tpu.models.streaming import bucketed_stream_scan
+    from dinov3_tpu.parallel.sharding import STAGING_ORDERS
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=4), devices=eight_devices)
+    shards = jnp.arange(4 * 64, dtype=jnp.float32).reshape(4, 64) * 0.01
+    x = jnp.ones((8, 16), jnp.bfloat16)
+    sh = jax.device_put(
+        shards, NamedSharding(mesh, P(None, ("data", "fsdp"))))
+    xx = jax.device_put(x, NamedSharding(mesh, P("data")))
+
+    ref = np.asarray(jax.jit(lambda s, v: bucketed_stream_scan(
+        s, v, mesh=mesh))(sh, xx))
+    for depth in (0, 1, 2):
+        got = jax.jit(lambda s, v, d=depth: bucketed_stream_scan(
+            s, v, mesh=mesh, prefetch=d))(sh, xx)
+        assert np.array_equal(ref, np.asarray(got)), f"depth {depth}"
+    for order in STAGING_ORDERS:
+        got = jax.jit(lambda s, v, o=order: bucketed_stream_scan(
+            s, v, mesh=mesh, prefetch=1, hierarchical=True,
+            staging_order=o))(sh, xx)
+        assert np.array_equal(ref, np.asarray(got)), order
+
+
+def test_staging_orders_equivalent_through_gather_schedule(
+        eight_devices):
+    """make_zero3_gather_schedule under all four staging orders:
+    forward bitwise identical (pure wire schedule), grads equal at
+    float tolerance (the RS transpose only reorders the reduction)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dinov3_tpu.parallel.sharding import (
+        STAGING_ORDERS,
+        split_staging_order,
+        zero3_leaf_spec,
+    )
+    from dinov3_tpu.train.fused_update import (
+        make_zero3_bucket_plan,
+        make_zero3_gather_schedule,
+    )
+
+    assert STAGING_ORDERS == (
+        "inter_intra", "intra_inter", "inter_inter", "intra_intra")
+    assert split_staging_order("intra_inter") == ("intra", "inter")
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=4), devices=eight_devices)
+    rng = np.random.default_rng(0)
+    tree_np = {"w": rng.normal(size=(64, 8)).astype(np.float32),
+               "b": rng.normal(size=(48,)).astype(np.float32)}
+
+    def put(x):
+        spec = zero3_leaf_spec(x.shape, (None,) * x.ndim, mesh)
+        return jax.device_put(jnp.asarray(x), NamedSharding(
+            mesh, spec if spec else P()))
+
+    tree = jax.tree.map(put, tree_np)
+    plan = make_zero3_bucket_plan(tree, mesh, target_bytes=2 ** 9)
+
+    def loss_of(g):
+        def loss(t):
+            return sum(jnp.sum(jnp.sin(le.astype(jnp.float32)))
+                       for le in jax.tree.leaves(g(t)))
+        return loss
+
+    outs, grads = {}, {}
+    for order in STAGING_ORDERS:
+        g = make_zero3_gather_schedule(plan, mesh, bucketed=True,
+                                       staging_order=order)
+        outs[order] = jax.jit(g)(tree)
+        grads[order] = jax.jit(jax.grad(loss_of(g)))(tree)
+    ref = outs["inter_intra"]
+    for order in STAGING_ORDERS[1:]:
+        for a, b in zip(jax.tree.leaves(ref),
+                        jax.tree.leaves(outs[order])):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), order
+        for a, b in zip(jax.tree.leaves(grads["inter_intra"]),
+                        jax.tree.leaves(grads[order])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6,
+                err_msg=order)
