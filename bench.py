@@ -383,6 +383,29 @@ def _zero3_summary(setup, coll_census) -> dict:
     return out
 
 
+def _lowp_summary(setup, coll_census) -> dict:
+    """The record's "low_precision" block: which precision arm was
+    benched (train.low_precision.arm), the setup drift probe's
+    per-kernel-site relative Frobenius drift (ops/lowp.py
+    lowp_drift_probe — None on the bf16 arm, which quantizes nothing),
+    and (census runs only) the streamed-collective story: the
+    ``zero3_stream`` scope the 1-byte weight gathers ride plus the
+    ``lowp_amax``/``lowp_dequant`` epilogue scopes — the phQ A/B reads
+    the bytes-vs-counts story straight from here."""
+    drift = getattr(setup, "lowp_drift", None)
+    out = {
+        "arm": getattr(setup, "lowp_arm", "bf16"),
+        "drift_max": drift.get("max") if drift else None,
+        "drift_by_site": ({k: v for k, v in drift.items() if k != "max"}
+                          if drift else None),
+    }
+    if coll_census and "by_scope" in coll_census:
+        out["collectives_by_scope"] = {
+            k: v for k, v in coll_census["by_scope"].items()
+            if k in ("zero3_stream", "lowp_amax", "lowp_dequant")}
+    return out
+
+
 def _bucket_summary(setup, coll_census) -> dict:
     """The record's "buckets" block: arm, plan shape (bucket count /
     payload / zero-pad fraction from BucketPlan.padding_stats) and
@@ -800,6 +823,10 @@ def main():
     # parallel.seq — every padded position costs real ring FLOPs)
     seq_pad_warnings = [str(w.message) for w in _bcaught
                         if "seq-padding axis" in str(w.message)]
+    # ... and the low-precision drift guardrail (configs/config.py
+    # warn_lowp_divergence: setup drift probe vs divergence_tol)
+    lowp_warnings = [str(w.message) for w in _bcaught
+                     if "lowp divergence axis" in str(w.message)]
     # ... and the tuned-plan resolver fallbacks (configs/config.py
     # resolve_bucket_mb / resolve_ring_min_seq / ...): an "auto" knob
     # that could not use the committed TUNED_* plan says so in the
@@ -1006,6 +1033,11 @@ def main():
         # the census ran — the bucket-scoped collective counts plus the
         # message-size histogram and issue-site placement
         "buckets": _bucket_summary(setup, coll_census),
+        # low-precision summary: which fp8/int8 arm was benched, the
+        # setup drift probe's per-site quantization drift, and — when
+        # the census ran — the streamed-gather + dequant-epilogue scope
+        # counts of the exact benched program (the phQ A/B instrument)
+        "low_precision": _lowp_summary(setup, coll_census),
         # tuned-plan provenance (tuning/plan.py): artifact path +
         # fingerprint, and per schedule knob the configured value, the
         # resolved value, and its source (tuned / explicit / fallback)
@@ -1031,6 +1063,8 @@ def main():
         rec["zero3_padding_warning"] = "; ".join(zero3_warnings)
     if bucket_warnings:
         rec["bucket_padding_warning"] = "; ".join(bucket_warnings)
+    if lowp_warnings:
+        rec["lowp_divergence_warning"] = "; ".join(lowp_warnings)
     if accum_warnings:
         rec["accum_tiling_warning"] = "; ".join(accum_warnings)
     if seq_pad_warnings:

@@ -52,6 +52,29 @@ def _adapt_opt_leaf(stored, like):
     )
 
 
+def _reseed_lowp_rings(restored, lowp_like):
+    """Fresh amax-history rings for a cross-arm restore — a bf16-arm (or
+    pre-lowp) checkpoint resuming into a quantized ``train.low_precision``
+    run, or a changed ``amax_history_len``. Seeded from the RESTORED
+    masters, the same rule fresh setups use
+    (``ops.lowp.lowp_history_init``), so the first H steps quantize
+    against the actual restored weights rather than stale or zero amax;
+    placed onto the like-rings' shardings."""
+    from dinov3_tpu.ops.lowp import lowp_history_init
+
+    H = int(jax.tree.leaves(lowp_like)[0].shape[-1])
+    fresh = {
+        k: lowp_history_init(restored.params[k]["backbone"], H)
+        for k in ("student", "teacher")
+    }
+
+    def put(v, like):
+        sharding = getattr(like, "sharding", None)
+        return jax.device_put(v, sharding) if sharding is not None else v
+
+    return jax.tree.map(put, fresh, lowp_like)
+
+
 def _bucketed_moments(state, plan) -> bool:
     """True when ``state``'s adam moments are in ``plan``'s bucket layout
     (the ``optim.bucketed_collectives`` engine,
@@ -376,10 +399,93 @@ class Checkpointer:
         replicated/zero3 arm) and re-buckets at the end
         (``_rebucket_moments`` — pure permutation + per-bucket
         device_put). Pinned in tests/test_buckets.py.
+
+        Checkpoints also cross ``train.low_precision`` arms: the lowp
+        amax-history rings (``TrainState.lowp``) restore directly when
+        the checkpoint carries matching rings; a bf16-arm / pre-lowp
+        checkpoint restoring into a quantized run (or an
+        ``amax_history_len`` change) gets FRESH rings reseeded from the
+        restored masters (``_reseed_lowp_rings``); a lowp checkpoint
+        restoring into a bf16 run discards the on-disk rings
+        (``state_like.lowp is None``; orbax insists every stored subtree
+        is requested, so the rings are requested abstractly from the
+        stored metadata and dropped). Pinned in tests/test_lowp.py.
         """
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found")
+        lowp_like = getattr(state_like, "lowp", None)
+        if lowp_like is not None and self._lowp_reseed_needed(
+                state_like, step):
+            restored = self._restore_arms(
+                state_like._replace(lowp=None), step)
+            restored = restored._replace(
+                lowp=_reseed_lowp_rings(restored, lowp_like))
+            logger.info(
+                "restored checkpoint at step %d (no matching lowp rings "
+                "on disk; amax histories reseeded from the restored "
+                "masters)", step)
+            return restored
+        if lowp_like is None:
+            stored_lowp = self._stored_lowp_abstract(step)
+            if stored_lowp is not None:
+                # lowp checkpoint into a bf16 run: request the rings
+                # abstractly (orbax refuses a request tree missing a
+                # stored subtree) and drop them — the bf16 arm carries
+                # no scaling state
+                return self._restore_arms(
+                    state_like._replace(lowp=stored_lowp), step
+                )._replace(lowp=None)
+        return self._restore_arms(state_like, step)
+
+    def _stored_lowp_abstract(self, step: int):
+        """Abstract (shape/dtype) tree of the lowp amax rings stored at
+        ``step``, or None when the save carried none. The local npz
+        backend reads only requested keys, so it never needs this."""
+        if self._local:
+            return None
+        try:
+            meta = item_metadata_tree(self.manager, step)["lowp"]
+        except (KeyError, TypeError, AttributeError):
+            return None
+        if meta is None:
+            return None
+        return jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(tuple(l.shape), l.dtype), meta)
+
+    def _lowp_reseed_needed(self, state_like, step: int) -> bool:
+        """True when ``state_like`` carries lowp rings but the checkpoint
+        has none (bf16-arm / pre-lowp save) or their shapes differ
+        (``amax_history_len`` changed across the restore)."""
+        import numpy as np
+
+        like_flat = jax.tree_util.tree_flatten_with_path(state_like.lowp)[0]
+        if self._local:
+            import os
+
+            with np.load(
+                os.path.join(self._directory, str(step), "state.npz")
+            ) as z:
+                for path, leaf in like_flat:
+                    key = ".lowp" + jax.tree_util.keystr(path)
+                    if key not in z.files or tuple(z[key].shape) != tuple(
+                            leaf.shape):
+                        return True
+            return False
+        try:
+            meta = item_metadata_tree(self.manager, step)
+            stored_flat = jax.tree_util.tree_flatten_with_path(
+                meta["lowp"])[0]
+        except (KeyError, TypeError, AttributeError):
+            return True
+        like_shapes = [(jax.tree_util.keystr(p), tuple(l.shape))
+                       for p, l in like_flat]
+        stored_shapes = [
+            (jax.tree_util.keystr(p), tuple(getattr(l, "shape", ())))
+            for p, l in stored_flat]
+        return stored_shapes != like_shapes
+
+    def _restore_arms(self, state_like: TrainState, step: int) -> TrainState:
         bucketed = _bucketed_moments(state_like, self.bucket_plan)
         if bucketed:
             # the like-state in the per-leaf ON-DISK layout; re-bucketed

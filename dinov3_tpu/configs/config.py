@@ -372,6 +372,62 @@ def zero3_stream_wished(cfg: ConfigNode) -> bool:
     )
 
 
+def lowp_cfg(cfg: ConfigNode) -> dict:
+    """The resolved ``train.low_precision`` block (ops/lowp.py): ``arm``
+    (bf16 = today's bitwise-unchanged path | fp8 | int8),
+    ``amax_history_len`` (delayed-scaling ring length),
+    ``scale_margin`` (headroom multiplier on the history amax), and
+    ``divergence_tol`` (the ``warn_lowp_divergence`` gate). All four are
+    registered in the tuning/census.py no-silent-knobs registry.
+    Raises on an unknown arm — a typo'd arm must never silently train
+    bf16."""
+    lp = (cfg.get("train") or {}).get("low_precision") or {}
+    arm = str(lp.get("arm", "bf16") or "bf16")
+    from dinov3_tpu.ops.lowp import LOWP_ARMS
+
+    if arm not in LOWP_ARMS:
+        raise ValueError(
+            f"train.low_precision.arm={arm!r}: expected one of {LOWP_ARMS}"
+        )
+    return {
+        "arm": arm,
+        "amax_history_len": int(lp.get("amax_history_len", 16) or 16),
+        "scale_margin": float(lp.get("scale_margin", 1.0) or 1.0),
+        "divergence_tol": float(lp.get("divergence_tol", 0.2) or 0.2),
+    }
+
+
+def warn_lowp_divergence(
+    drift: float, tol: float = 0.2, stacklevel: int = 2,
+    axis: str = "lowp train matmuls",
+) -> str | None:
+    """Warn when the measured per-layer lowp-vs-bf16 matmul drift (the
+    device-side shadow-matmul probe ``lowp_drift_probe``, ops/lowp.py —
+    relative Frobenius error on a sampled layer) exceeds
+    ``train.low_precision.divergence_tol`` — a config whose quantized
+    matmuls have left the bf16 arm's band refuses to train silently,
+    the training-side analogue of ``warn_quant_drift``. Fired at
+    training-setup build (train/setup.py) and captured into every bench
+    record (bench.py ``lowp_divergence_warning``). Returns the message
+    or None when the drift is inside the band."""
+    if drift <= tol:
+        return None
+    msg = (
+        f"lowp divergence axis [{axis}]: measured quantized-matmul "
+        f"drift {drift:.4g} vs the bf16 shadow exceeds "
+        f"train.low_precision.divergence_tol={tol:.4g} — delayed "
+        f"scaling cannot represent these kernels at this arm's "
+        f"precision. Train this config in bf16 "
+        f"(train.low_precision.arm=bf16), raise scale_margin, or raise "
+        f"the tolerance only with a pinned loss-trajectory check "
+        f"(docs/PERFORMANCE.md low-precision section)."
+    )
+    import warnings
+
+    warnings.warn(msg, stacklevel=stacklevel + 1)
+    return msg
+
+
 def warn_zero3_padding(
     waste: float, dp: int, threshold: float = 0.01, stacklevel: int = 2,
 ) -> str | None:

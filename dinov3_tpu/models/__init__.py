@@ -124,6 +124,15 @@ def backbone_kwargs_from_cfg(cfg: ConfigNode, *, teacher: bool = False) -> dict:
     from dinov3_tpu.configs.config import zero3_stream_wished
 
     kw["zero3_stream"] = zero3_stream_wished(cfg)
+    # train.low_precision: fp8/int8 delayed-scaling block matmuls
+    # (ops/lowp.py). BOTH student and teacher forward through the
+    # quantized matmuls (the EMA STORAGE stays fp32 — only the teacher's
+    # forward compute is quantized, the same way it already runs bf16);
+    # eval builds and the gram teacher never receive a scale collection,
+    # so the attr is inert there (the has_variable guard).
+    from dinov3_tpu.configs.config import lowp_cfg
+
+    kw["lowp_arm"] = lowp_cfg(cfg)["arm"]
     # fp8 projections inside blocks when the filter regex matches "blocks"
     # (reference config surface: student.fp8_enabled / fp8_filter,
     # ssl_default_config.yaml:121-122). Student only: the EMA teacher's
@@ -159,6 +168,13 @@ def build_backbone(cfg: ConfigNode, *, teacher: bool = False,
     the recipe's storage dtype."""
     arch = cfg.student.arch
     if arch.startswith("convnext"):
+        from dinov3_tpu.configs.config import lowp_cfg
+
+        if lowp_cfg(cfg)["arm"] != "bf16":
+            raise ValueError(
+                f"train.low_precision.arm={lowp_cfg(cfg)['arm']!r} requires "
+                "a ViT backbone (the quantized matmuls live in the "
+                "attn/mlp block kernels); student.arch=" + arch)
         from dinov3_tpu.models.convnext import (
             convnext_kwargs_from_cfg,
             get_convnext_arch,

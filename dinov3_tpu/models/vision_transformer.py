@@ -65,6 +65,7 @@ class _CollectScanBlock(nn.Module):
         x = remat_block_cls(
             self.remat, self.zero3_stream, self.stream_dtype,
             stream_init=self.is_initializing(),
+            lowp_arm=self.block_kwargs.get("lowp_arm", "bf16"),
         )(
             **self.block_kwargs, name="block"
         )(x, rope, deterministic, dp_plan)
@@ -122,6 +123,10 @@ class DinoVisionTransformer(nn.Module):
     # parallel.zero3 by build_backbone (models/__init__.py); inert
     # without a sharded mesh.
     zero3_stream: bool = False
+    # train.low_precision.arm: fp8/int8 delayed-scaling block matmuls
+    # (ops/lowp.py); scales arrive as the read-only "lowp" variable
+    # collection and the bf16 arm is today's bitwise-unchanged path
+    lowp_arm: str = "bf16"
     remat: str = "none"  # none | blocks | full
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -232,6 +237,7 @@ class DinoVisionTransformer(nn.Module):
             flash_min_seq=self.flash_min_seq,
             ring_min_seq=self.ring_min_seq,
             seq_parallel=self.seq_parallel, fp8=self.fp8,
+            lowp_arm=self.lowp_arm,
             moe_num_experts=self.moe_num_experts, moe_top_k=self.moe_top_k,
             dtype=self.dtype, param_dtype=self.param_dtype,
             reduce_dtype=self.reduce_dtype, probs_dtype=self.probs_dtype,
@@ -263,6 +269,11 @@ class DinoVisionTransformer(nn.Module):
         if self.pipeline_stages > 1:
             from dinov3_tpu.parallel.pipeline import PipelinedBlocks
 
+            if self.lowp_arm != "bf16":
+                raise ValueError(
+                    "train.low_precision is not supported under pipeline "
+                    "parallelism (per-stage scale plumbing is not wired); "
+                    "set train.low_precision.arm=bf16")
             if seg is not None:
                 raise ValueError(
                     "crop packing is not supported under pipeline "
@@ -279,7 +290,10 @@ class DinoVisionTransformer(nn.Module):
         elif self.scan_layers and not collect:
             scanned = nn.scan(
                 ScanBlockAdapter,
-                variable_axes={"params": 0, "losses": 0},
+                # "lowp": per-layer delayed scales ([L] per kernel) ride
+                # the scan like the stacked params — each iteration sees
+                # its own layer's scalar scale (ops/lowp.py)
+                variable_axes={"params": 0, "losses": 0, "lowp": 0},
                 split_rngs={"params": True, "drop_path": True, "dropout": True},
                 in_axes=(0 if plan is not None else nn.broadcast,
                          nn.broadcast, nn.broadcast, nn.broadcast),
@@ -293,7 +307,7 @@ class DinoVisionTransformer(nn.Module):
             take = tuple(sorted(collect))
             scanned = nn.scan(
                 _CollectScanBlock,
-                variable_axes={"params": 0, "losses": 0},
+                variable_axes={"params": 0, "losses": 0, "lowp": 0},
                 split_rngs={"params": True, "drop_path": True, "dropout": True},
                 in_axes=(0, 0 if plan is not None else nn.broadcast,
                          nn.broadcast, nn.broadcast),
@@ -315,6 +329,7 @@ class DinoVisionTransformer(nn.Module):
                 x = remat_block_cls(
                     self.remat, self.zero3_stream, stream_dtype,
                     stream_init=self.is_initializing(),
+                    lowp_arm=self.lowp_arm,
                 )(
                     **self._block_kwargs(), name=f"blocks_{i}"
                 )(x, rope, deterministic, plan_layer_slice(plan, i), seg)

@@ -212,6 +212,10 @@ class SelfAttention(nn.Module):
     attn_impl: str = "auto"
     seq_parallel: bool = False
     fp8: bool = False  # current-scaling fp8 projections (ops/common.py)
+    # train.low_precision.arm: delayed-scaling fp8/int8 matmuls
+    # (ops/lowp.py) — engaged only when the "lowp" scale collection is
+    # present (training applies), so init/eval stay on the bf16 path
+    lowp_arm: str = "bf16"
     causal: bool = False  # triangular mask (dense XLA path only)
     flash_block_q: int = 512   # kernels.flash_block_q/kv caps
     flash_block_kv: int = 512
@@ -241,7 +245,21 @@ class SelfAttention(nn.Module):
             (self.dim, 3 * self.dim), self.param_dtype,
         )
         mm = fp8_matmul if self.fp8 else (lambda a, b: a @ b)
-        qkv = mm(x.astype(self.dtype), qkv_kernel.astype(self.dtype))
+
+        def lowp_mm(name):
+            """Quantized-arm matmul for the kernel whose delayed scale
+            is at ``("lowp", name)`` — falls back to ``mm`` when the
+            arm is bf16 or no scale collection rode this apply (init,
+            eval, the gram teacher)."""
+            if self.lowp_arm == "bf16" or not self.has_variable("lowp", name):
+                return mm
+            from dinov3_tpu.ops.lowp import lowp_matmul
+
+            scale = self.get_variable("lowp", name)
+            return lambda a, b: lowp_matmul(self.lowp_arm, a, b, scale)
+
+        qkv = lowp_mm("qkv_kernel")(
+            x.astype(self.dtype), qkv_kernel.astype(self.dtype))
         if self.qkv_bias:
             qkv_b = self.param(
                 "qkv_bias", part(nn.initializers.zeros, ("heads",)),
@@ -319,7 +337,8 @@ class SelfAttention(nn.Module):
             "proj_kernel", part(trunc_normal_init(), ("heads", "embed")),
             (self.dim, self.dim), self.param_dtype,
         )
-        y = mm(out.astype(self.dtype), proj_kernel.astype(self.dtype))
+        y = lowp_mm("proj_kernel")(
+            out.astype(self.dtype), proj_kernel.astype(self.dtype))
         if self.proj_bias:
             proj_b = self.param(
                 "proj_bias", part(nn.initializers.zeros, ("embed",)),

@@ -50,6 +50,9 @@ class SelfAttentionBlock(nn.Module):
     flash_block_kv: int = 512
     flash_min_seq: int = 0
     ring_min_seq: int = 0
+    # train.low_precision.arm: fp8/int8 quantized matmuls over the
+    # castable kernels (ops/lowp.py); "bf16" = the unchanged path
+    lowp_arm: str = "bf16"
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     reduce_dtype: Any = jnp.float32
@@ -92,6 +95,7 @@ class SelfAttentionBlock(nn.Module):
             flash_block_q=self.flash_block_q,
             flash_block_kv=self.flash_block_kv,
             flash_min_seq=self.flash_min_seq,
+            lowp_arm=self.lowp_arm,
             ring_min_seq=self.ring_min_seq, dtype=self.dtype,
             param_dtype=self.param_dtype, reduce_dtype=self.reduce_dtype,
             probs_dtype=self.probs_dtype,
@@ -100,8 +104,8 @@ class SelfAttentionBlock(nn.Module):
         mlp = make_ffn_layer(
             self.ffn_layer, int(self.dim * self.ffn_ratio),
             moe_num_experts=self.moe_num_experts, moe_top_k=self.moe_top_k,
-            use_bias=self.ffn_bias, fp8=self.fp8, dtype=self.dtype,
-            param_dtype=self.param_dtype, name="mlp",
+            use_bias=self.ffn_bias, fp8=self.fp8, lowp_arm=self.lowp_arm,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="mlp",
         )
 
         # per-row context (crop packing): the subset gather must carry
@@ -198,7 +202,8 @@ def stream_bucket_leaves(stack_params):
     ]
 
 
-def _zero3_stream_trans_in(stream_dtype, constrain: bool = True):
+def _zero3_stream_trans_in(stream_dtype, constrain: bool = True,
+                           lowp_kernels: bool = False):
     """``nn.map_variables`` trans_in_fn for the ZeRO-3 weight stream.
 
     Materializes ONE block's sharded weights for compute, inside the
@@ -216,6 +221,13 @@ def _zero3_stream_trans_in(stream_dtype, constrain: bool = True):
     ``constrain=False`` applies only the cast (no materialization) —
     kept for callers that want the stream dtype without forcing a
     placement.
+
+    ``lowp_kernels=True`` (a fp8/int8 ``train.low_precision`` arm): the
+    castable matmul KERNELS (``lowp_kernel_path``, ops/lowp.py) get the
+    cast + the master-placement pin but NOT the replicated constraint —
+    they stay sharded, and the quantized-matmul ``custom_vjp``
+    (``lowp_matmul``) gathers their 1-byte codes under the same
+    ``zero3_stream`` scope instead. Biases keep the full bf16 stream.
 
     No-op (constraint-wise) without an active mesh, so the wrapped block
     stays usable in unsharded tests/eval.
@@ -248,6 +260,13 @@ def _zero3_stream_trans_in(stream_dtype, constrain: bool = True):
                     from jax.experimental.shard_alike import shard_alike
 
                     p, _ = shard_alike(p, master)
+            if lowp_kernels:
+                from dinov3_tpu.ops.lowp import lowp_kernel_path
+
+                if lowp_kernel_path(path):
+                    # quantized arm: leave the kernel SHARDED — the
+                    # lowp_matmul custom_vjp gathers its int8/fp8 codes
+                    return p
             if not constrain:
                 return p
             return constrain_replicated(p, mesh) if mesh is not None else p
@@ -259,7 +278,8 @@ def _zero3_stream_trans_in(stream_dtype, constrain: bool = True):
 
 
 def remat_block_cls(remat: str, zero3_stream: bool = False,
-                    stream_dtype=None, stream_init: bool = False):
+                    stream_dtype=None, stream_init: bool = False,
+                    lowp_arm: str = "bf16"):
     """SelfAttentionBlock, optionally wrapped for rematerialization and
     the ZeRO-3 weight stream.
 
@@ -293,7 +313,8 @@ def remat_block_cls(remat: str, zero3_stream: bool = False,
     if zero3_stream and not stream_init:
         base = nn.map_variables(
             SelfAttentionBlock, "params",
-            trans_in_fn=_zero3_stream_trans_in(stream_dtype),
+            trans_in_fn=_zero3_stream_trans_in(
+                stream_dtype, lowp_kernels=(lowp_arm != "bf16")),
         )
     if remat == "attn":
         return nn.remat(
@@ -336,6 +357,7 @@ class ScanBlockAdapter(nn.Module):
         x = remat_block_cls(
             self.remat, self.zero3_stream, self.stream_dtype,
             stream_init=self.is_initializing(),
+            lowp_arm=self.block_kwargs.get("lowp_arm", "bf16"),
         )(
             **self.block_kwargs, name="block"
         )(x, rope, deterministic, dp_plan, seg)

@@ -21,6 +21,24 @@ def _dense_kwargs(fp8: bool) -> dict:
     return {"dot_general": fp8_dot_general} if fp8 else {}
 
 
+def _lowp_dense_kwargs(module: nn.Module, kernel: str) -> dict:
+    """Per-Dense ``dot_general`` override for a fp8/int8
+    ``train.low_precision`` arm: the OWNING module reads the kernel's
+    delayed scale from the read-only ``"lowp"`` collection (a Dense
+    submodule cannot see sibling collections — scales live at the FFN
+    module as ``fc1_kernel``-style names, ops/lowp.py
+    ``lowp_scale_site``) and closes it over ``lowp_matmul``. Falls back
+    to the legacy fp8 hook / plain dot when the arm is bf16 or no scale
+    collection rode this apply (init, eval, the gram teacher)."""
+    arm = getattr(module, "lowp_arm", "bf16")
+    if arm == "bf16" or not module.has_variable("lowp", kernel):
+        return _dense_kwargs(module.fp8)
+    from dinov3_tpu.ops.lowp import make_lowp_dot_general
+
+    return {"dot_general": make_lowp_dot_general(
+        module.get_variable("lowp", kernel), arm)}
+
+
 def exact_gelu(x):
     """erf-based GELU — what torch ``nn.GELU()`` (and hence Meta's DINOv3)
     computes; flax's ``nn.gelu`` defaults to the tanh approximation, which
@@ -37,6 +55,7 @@ class Mlp(nn.Module):
     use_bias: bool = True
     dropout_rate: float = 0.0
     fp8: bool = False
+    lowp_arm: str = "bf16"  # train.low_precision.arm (ops/lowp.py)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -48,7 +67,7 @@ class Mlp(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=part(trunc_normal_init(), ("embed", "mlp")),
             bias_init=part(nn.initializers.zeros, ("mlp",)),
-            name="fc1", **_dense_kwargs(self.fp8),
+            name="fc1", **_lowp_dense_kwargs(self, "fc1_kernel"),
         )(x)
         x = self.act(x)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
@@ -57,7 +76,7 @@ class Mlp(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=part(trunc_normal_init(), ("mlp", "embed")),
             bias_init=part(nn.initializers.zeros, ("embed",)),
-            name="fc2", **_dense_kwargs(self.fp8),
+            name="fc2", **_lowp_dense_kwargs(self, "fc2_kernel"),
         )(x)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         return x
@@ -75,6 +94,7 @@ class SwiGLUFFN(nn.Module):
     use_bias: bool = True
     align_to: int = 64  # keep the hidden dim MXU/lane aligned on TPU
     fp8: bool = False
+    lowp_arm: str = "bf16"  # train.low_precision.arm (ops/lowp.py)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -88,7 +108,7 @@ class SwiGLUFFN(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=part(trunc_normal_init(), ("embed", "mlp")),
             bias_init=part(nn.initializers.zeros, ("mlp",)),
-            name="w12", **_dense_kwargs(self.fp8),
+            name="w12", **_lowp_dense_kwargs(self, "w12_kernel"),
         )(x)
         gate, value = jnp.split(w12, 2, axis=-1)
         x = nn.silu(gate) * value
@@ -97,7 +117,7 @@ class SwiGLUFFN(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=part(trunc_normal_init(), ("mlp", "embed")),
             bias_init=part(nn.initializers.zeros, ("embed",)),
-            name="w3", **_dense_kwargs(self.fp8),
+            name="w3", **_lowp_dense_kwargs(self, "w3_kernel"),
         )(x)
 
 
@@ -128,6 +148,7 @@ class MoEFFN(nn.Module):
     act: Callable = exact_gelu
     use_bias: bool = True
     fp8: bool = False  # accepted for make_ffn_layer symmetry; dense path only
+    lowp_arm: str = "bf16"  # symmetry only (setup raises on lowp + moe)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 

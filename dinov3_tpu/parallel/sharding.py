@@ -107,6 +107,19 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def lowp_scale_specs(tree: Any, mesh: Mesh) -> Any:
+    """Replicated NamedShardings for a lowp amax-history/scale tree
+    (ops/lowp.py): every leaf is a tiny f32 [H] (or [L, H] scanned)
+    ring at a castable-kernel scale site — bytes are negligible next to
+    one master leaf, and every device needs the derived scale at the
+    quantize sites, so replicated is the only placement that never adds
+    a collective. Kept explicit (rather than relying on the unboxed ->
+    replicated default of ``state_shardings_from_abstract``) so the
+    zero3 ``_replace`` overrides in setup can pin the lowp subtree
+    deliberately alongside the sharded params/moments."""
+    return jax.tree.map(lambda _: replicated(mesh), tree)
+
+
 def batch_sharding(mesh: Mesh, seq_dim: int | None = None) -> NamedSharding:
     """Sharding for one batch leaf: dim 0 over all data axes, optional
     token dim over seq."""

@@ -51,25 +51,32 @@ class QuantLeaf(NamedTuple):
 def quantizable_path(path) -> bool:
     """Whether the leaf at ``path`` is int8-quantized: an attn/mlp
     matmul kernel by the stream-castable rule (ops/block.py), excluding
-    everything else castable (biases) — matmul weights only."""
-    from dinov3_tpu.ops.block import stream_castable_path
+    everything else castable (biases) — matmul weights only. Same rule
+    as the training arms' ``lowp_kernel_path`` (ops/lowp.py), which owns
+    it now."""
+    from dinov3_tpu.ops.lowp import lowp_kernel_path
 
-    if not path or not stream_castable_path(path):
-        return False
-    last = str(getattr(path[-1], "key", getattr(path[-1], "idx", path[-1])))
-    return "kernel" in last
+    return lowp_kernel_path(path)
 
 
 def quantize_leaf(w) -> QuantLeaf:
     """f32 host quantization of one kernel: symmetric per-output-channel
     scale ``amax(|w|, axis=-2)/127`` (zero channels get scale 1.0 so the
     divide is exact and dequant returns exact zeros), codes rounded
-    half-to-even and clipped to [-127, 127] (symmetric: -128 unused)."""
+    half-to-even and clipped to [-127, 127] (symmetric: -128 unused).
+
+    The scale/round/clip math is ``ops.lowp.symmetric_scale`` /
+    ``symmetric_quantize`` in numpy form — one set of quantization
+    numerics shared by the serve engines (per-output-channel, host
+    numpy) and the fp8/int8 training arms (per-tensor, traced), pinned
+    bitwise-identical to the pre-refactor expressions in
+    tests/test_serve.py / tests/test_lowp.py."""
+    from dinov3_tpu.ops.lowp import symmetric_quantize, symmetric_scale
+
     w32 = np.asarray(w).astype(np.float32)
     amax = np.max(np.abs(w32), axis=-2, keepdims=True)
-    scale = np.where(amax > 0, amax / np.float32(127.0), np.float32(1.0))
-    scale = scale.astype(np.float32)
-    q = np.clip(np.rint(w32 / scale), -127, 127).astype(np.int8)
+    scale = symmetric_scale(amax, 127.0, xp=np)
+    q = symmetric_quantize(w32, scale, 127, np.int8, xp=np)
     return QuantLeaf(q=jnp.asarray(q), scale=jnp.asarray(scale))
 
 

@@ -105,6 +105,28 @@ def ema_leaf(t: jnp.ndarray, s: jnp.ndarray, momentum) -> jnp.ndarray:
     ).astype(t.dtype)
 
 
+def lowp_state_step(lowp_state: Any, new_student: Any, new_teacher: Any):
+    """Advance both fp8/int8 delayed-scaling amax-history rings from the
+    UPDATED masters (train.low_precision, ops/lowp.py).
+
+    Part of the update epilogue the same way ``ema_leaf`` is: the step
+    calls it right after the fused (or optax-oracle) parameter pass, so
+    XLA fuses the per-kernel amax reductions into the update's tail —
+    they read the freshly written masters while those are still hot, and
+    under zero3 each amax over a sharded master is one scalar
+    all-reduce-max under the ``lowp_amax`` named scope. Next step's
+    scales therefore lag the weights by exactly one step (the standard
+    delayed-scaling recipe)."""
+    from dinov3_tpu.ops.lowp import lowp_history_step
+
+    return {
+        "student": lowp_history_step(
+            lowp_state["student"], new_student["backbone"]),
+        "teacher": lowp_history_step(
+            lowp_state["teacher"], new_teacher["backbone"]),
+    }
+
+
 # pytree-leaf sentinel for "no clip scale" (None would be treated as an
 # empty subtree and break the structure match in the fused tree.map)
 _NO_CLIP = object()

@@ -102,6 +102,11 @@ class TrainSetup:
     zero3_buckets: bool = False
     zero3_bucket_plan: Any = None  # Zero3GatherPlan (student tree)
     accum_steps: int = 1  # microbatched gradient accumulation
+    # train.low_precision (ops/lowp.py): resolved arm + the setup-time
+    # quantization drift probe ({site: rel-Frobenius, "max": worst}),
+    # None on the bf16 arm / compile-only setups
+    lowp_arm: str = "bf16"
+    lowp_drift: dict | None = None
     # lazy TelemetryPlan builder; None = telemetry.async_metrics=false
     # (the per-step-fetch oracle path is then the only metrics path)
     telemetry_builder: Callable | None = None
@@ -189,6 +194,35 @@ def _build_train_setup(
             stacklevel=2,
         )
         cfg.train.scan_layers = False
+    # train.low_precision (ops/lowp.py): fp8/int8 delayed-scaling block
+    # matmuls on the zero3 stream. Arm conflicts raise here (setup is the
+    # first place every interacting knob is resolved together):
+    from dinov3_tpu.configs.config import lowp_cfg
+
+    lp = lowp_cfg(cfg)
+    if lp["arm"] != "bf16":
+        if bool(cfg.student.get("fp8_enabled", False)):
+            raise ValueError(
+                f"train.low_precision.arm={lp['arm']!r} conflicts with "
+                "student.fp8_enabled=true: both would quantize the same "
+                "block matmuls (the legacy fp8 hook uses current "
+                "per-tensor scaling, the lowp arms delayed scaling). "
+                "Pick one — arm=fp8 supersedes fp8_enabled."
+            )
+        if str(cfg.student.get("ffn_layer", "mlp")) == "moe":
+            raise ValueError(
+                f"train.low_precision.arm={lp['arm']!r} does not support "
+                "student.ffn_layer=moe: the expert einsums are not "
+                "stream-castable Dense kernels (ops/block.py "
+                "stream_castable_path excludes router/expert leaves)."
+            )
+        if int((cfg.get("parallel") or {}).get("pipe", 1) or 1) > 1:
+            raise ValueError(
+                f"train.low_precision.arm={lp['arm']!r} is not supported "
+                "under pipeline parallelism (parallel.pipe>1): the "
+                "pipelined block stack bypasses the per-block zero3 "
+                "stream the quantized gathers ride."
+            )
     meta = SSLMetaArch(cfg)
     schedules = build_schedules(cfg)
 
@@ -407,11 +441,30 @@ def _build_train_setup(
                     nu=sharded_adam_zeros(student_unboxed, dp),
                 )
             )
+        lowp_state = None
+        if lp["arm"] != "bf16":
+            # amax-history rings seeded with the CURRENT master amax in
+            # every slot (zero-filled rings would scale the first H steps
+            # by 1.0 — instant divergence on ~0.02-std kernels); tiny f32
+            # leaves at the castable-kernel scale sites only
+            import flax.linen as nn
+
+            from dinov3_tpu.ops.lowp import lowp_history_init
+
+            lowp_state = {
+                "student": lowp_history_init(
+                    nn.meta.unbox(params["student"]["backbone"]),
+                    lp["amax_history_len"]),
+                "teacher": lowp_history_init(
+                    nn.meta.unbox(params["teacher"]["backbone"]),
+                    lp["amax_history_len"]),
+            }
         return TrainState(
             params=params,
             opt_state=opt_state,
             center_state=meta.init_state(),
             step=jnp.zeros((), jnp.int32),
+            lowp=lowp_state,
         )
 
     abstract = jax.eval_shape(boxed_init, rng)
@@ -455,6 +508,14 @@ def _build_train_setup(
         ]
         warn_zero3_padding(zero3_replicated_waste(pairs, mesh), dp)
 
+    if abstract.lowp is not None:
+        # amax-history rings pinned replicated explicitly (tiny f32
+        # leaves; every device derives the same scales at quantize time)
+        from dinov3_tpu.parallel.sharding import lowp_scale_specs
+
+        state_shardings = state_shardings._replace(
+            lowp=lowp_scale_specs(abstract.lowp, mesh))
+
     import flax.linen as nn
 
     if init_state:
@@ -466,6 +527,27 @@ def _build_train_setup(
             state = init_jit(rng)
     else:
         state = nn.meta.unbox(abstract)
+
+    # quantization-drift guardrail (configs.config.warn_lowp_divergence):
+    # a device-side per-layer probe compares the quantized lowp matmul
+    # against the bf16 shadow on the sampled layer of every castable
+    # kernel at the INITIAL masters/scales — a mis-tuned arm (margin,
+    # ring length, int8 on an unsuited recipe) fires here at setup build
+    # instead of surfacing as a silent loss divergence hours in. bench
+    # captures the warning into its records (the warn_* convention).
+    lowp_drift = None
+    if lp["arm"] != "bf16" and init_state:
+        from dinov3_tpu.configs.config import warn_lowp_divergence
+        from dinov3_tpu.ops.lowp import lowp_drift_probe
+
+        with mesh:
+            probe = lowp_drift_probe(
+                state.params["student"]["backbone"], state.lowp["student"],
+                lp["arm"], lp["scale_margin"])
+        lowp_drift = {k: float(v) for k, v in probe.items()}
+        warn_lowp_divergence(
+            lowp_drift["max"], tol=lp["divergence_tol"],
+            axis=f"lowp train matmuls ({lp['arm']})")
 
     b_shardings = batch_specs(mesh, example_batch)
     # microbatched gradient accumulation (optim.accum_steps): the step
@@ -520,6 +602,7 @@ def _build_train_setup(
         monitor_grad_norm=cfg.train.monitor_gradient_norm,
         fused_update=fused,
         accum_steps=accum_steps,
+        lowp=lp,
     )
     rep = replicated(mesh)
     scalar_shardings = {"teacher_temp": rep, "momentum": rep}
@@ -587,6 +670,8 @@ def _build_train_setup(
         zero3_buckets=use_zero3_buckets,
         zero3_bucket_plan=zero3_bucket_plan,
         accum_steps=accum_steps,
+        lowp_arm=lp["arm"],
+        lowp_drift=lowp_drift,
         telemetry_builder=telemetry_builder,
     )
 

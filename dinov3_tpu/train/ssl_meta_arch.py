@@ -416,10 +416,17 @@ class SSLMetaArch:
         )
 
     def _apply_backbone(self, module, params, x, masks=None, *, crop_kind,
-                        train, rngs=None, rng_plan=None, local_crops=None):
+                        train, rngs=None, rng_plan=None, local_crops=None,
+                        lowp=None):
         # rng_plan is a ViT-only kwarg (ConvNeXt backbones keep the
         # legacy rng path — meta init never enables the plan for them);
-        # local_crops likewise (the crop-packed single-pass engine)
+        # local_crops likewise (the crop-packed single-pass engine).
+        # ``lowp``: read-only delayed-scaling collection for the fp8/int8
+        # train.low_precision arms (ops/lowp.py) — when absent the
+        # modules' has_variable guard keeps the plain bf16 matmuls.
+        variables = {"params": params}
+        if lowp is not None:
+            variables["lowp"] = lowp
         plan_kw = {} if rng_plan is None else {"rng_plan": rng_plan}
         if local_crops is not None:
             plan_kw["local_crops"] = local_crops
@@ -427,7 +434,7 @@ class SSLMetaArch:
             # MoE blocks sow their Switch-style load-balance terms into the
             # "losses" collection; collect them for compute_losses
             out, aux_vars = module.apply(
-                {"params": params}, x, masks, crop_kind=crop_kind,
+                variables, x, masks, crop_kind=crop_kind,
                 deterministic=not train, rngs=rngs, mutable=["losses"],
                 **plan_kw,
             )
@@ -461,7 +468,7 @@ class SSLMetaArch:
                 out["moe_aux_loss"] = sum(terms) / len(terms)
             return out
         return module.apply(
-            {"params": params}, x, masks, crop_kind=crop_kind,
+            variables, x, masks, crop_kind=crop_kind,
             deterministic=not train, rngs=rngs, **plan_kw,
         )
 
@@ -472,14 +479,15 @@ class SSLMetaArch:
         )
 
     def get_teacher_output(
-        self, teacher_params, batch, teacher_temp, state, update_centers=True
+        self, teacher_params, batch, teacher_temp, state, update_centers=True,
+        lowp=None,
     ):
         g = batch["global_crops"]
         n_g = 2
         B = g.shape[0] // n_g
         out = self._apply_backbone(
             self.teacher_backbone, teacher_params["backbone"], g,
-            crop_kind="global", train=False,
+            crop_kind="global", train=False, lowp=lowp,
         )
         cls = out["x_norm_clstoken"]  # [2B, D_t]
         patches = out["x_norm_patchtokens"]  # [2B, T, D_t]
@@ -572,7 +580,8 @@ class SSLMetaArch:
             "masked_target": masked_target,
         }, new_state
 
-    def get_student_output(self, student_params, batch, rngs, rng_plan=None):
+    def get_student_output(self, student_params, batch, rngs, rng_plan=None,
+                           lowp=None):
         g = batch["global_crops"]
         l = batch["local_crops"]
         n_g, n_l = 2, self.n_local_crops
@@ -589,7 +598,7 @@ class SSLMetaArch:
                 self.student_backbone, student_params["backbone"], g, masks,
                 crop_kind="global", train=True, rngs=rngs,
                 rng_plan=None if rng_plan is None else rng_plan["packed"],
-                local_crops=l,
+                local_crops=l, lowp=lowp,
             )
             g_cls, g_patch = out["x_norm_clstoken"], out["x_norm_patchtokens"]
             l_cls = out["local_cls"]
@@ -603,20 +612,23 @@ class SSLMetaArch:
             g_out = self._apply_backbone(
                 self.student_backbone, student_params["backbone"], g, masks,
                 crop_kind="global", train=True, rng_plan=rng_plan["global"],
+                lowp=lowp,
             )
             l_out = self._apply_backbone(
                 self.student_backbone, student_params["backbone"], l, None,
                 crop_kind="local", train=True, rng_plan=rng_plan["local"],
+                lowp=lowp,
             )
         else:
             g_out = self._apply_backbone(
                 self.student_backbone, student_params["backbone"], g, masks,
-                crop_kind="global", train=True, rngs=rngs,
+                crop_kind="global", train=True, rngs=rngs, lowp=lowp,
             )
             l_out = self._apply_backbone(
                 self.student_backbone, student_params["backbone"], l, None,
                 crop_kind="local", train=True,
                 rngs={k: jax.random.fold_in(v, 1) for k, v in rngs.items()},
+                lowp=lowp,
             )
         if not self.crop_packing:
             g_cls, g_patch = (g_out["x_norm_clstoken"],
@@ -828,6 +840,7 @@ class SSLMetaArch:
         rng_plan=None,
         update_centers=True,
         gather_params=True,
+        lowp=None,
     ):
         """Loss for one batch. ``frozen_params`` = {"teacher": ..,
         ["gram": ..]} under stop_gradient; gradients flow only through
@@ -837,7 +850,14 @@ class SSLMetaArch:
         and consume neither. ``gather_params=False`` skips the zero3
         gathers — the microbatched accumulation path hoists them outside
         its scan (one gather + one grad-RS per OPTIMIZER step, not per
-        microbatch) and passes already-replicated trees."""
+        microbatch) and passes already-replicated trees.
+
+        ``lowp``: ``{"student": scales, "teacher": scales}`` read-only
+        delayed-scaling trees for the fp8/int8 ``train.low_precision``
+        arms (ops/lowp.py ``lowp_scales``) — both backbones forward
+        through the quantized matmuls; the gram teacher never receives
+        the collection (its anchoring features stay bf16)."""
+        lowp = lowp or {}
         frozen = jax.lax.stop_gradient(frozen_params)
         # ZeRO-3: replicate the non-streamed master subtrees for this
         # step's compute (heads/patch-embed/norms; the block stacks stay
@@ -849,9 +869,11 @@ class SSLMetaArch:
             frozen = self._zero3_gather_params(frozen)
         teacher_global, new_state = self.get_teacher_output(
             frozen["teacher"], batch, teacher_temp, state, update_centers,
+            lowp=lowp.get("teacher"),
         )
         student_global, student_local = self.get_student_output(
-            student_params, batch, rngs, rng_plan=rng_plan
+            student_params, batch, rngs, rng_plan=rng_plan,
+            lowp=lowp.get("student"),
         )
         gram_feats = None
         if self.gram_enabled:

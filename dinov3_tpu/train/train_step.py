@@ -58,6 +58,12 @@ class TrainState(NamedTuple):
     opt_state: Any
     center_state: Any  # softmax-centering EMA centers
     step: jnp.ndarray
+    # fp8/int8 delayed-scaling amax-history rings (ops/lowp.py):
+    # {"student": tree, "teacher": tree} of f32 [H] (or [L, H] scanned)
+    # leaves at the castable-kernel scale sites, advanced once per step
+    # AFTER the optimizer/EMA update. None on the bf16 arm — the default
+    # path carries no extra state and stays bitwise-identical.
+    lowp: Any = None
 
 
 def split_microbatches(batch: dict, accum_steps: int) -> dict:
@@ -112,6 +118,7 @@ def make_train_step(
     monitor_grad_norm: bool = False,
     fused_update: Callable | None = None,
     accum_steps: int = 1,
+    lowp: dict | None = None,
 ) -> Callable:
     """Returns step(state, batch, scalars, rng) -> (state, metrics).
 
@@ -138,10 +145,21 @@ def make_train_step(
     (up to reduction order) while peak activation memory drops by
     ~accum_steps. ``accum_steps=1`` is byte-for-byte the monolithic
     path.
+
+    ``lowp`` (``configs.config.lowp_cfg``): the fp8/int8 delayed-scaling
+    arm config. On a quantized arm the step computes this step's scales
+    from the carried amax-history rings BEFORE the forward
+    (``ops.lowp.lowp_scales`` — pure elementwise math on tiny f32
+    leaves), threads them through ``meta.forward`` as the read-only
+    "lowp" collection, and advances the rings from the UPDATED masters
+    after the optimizer/EMA update (``lowp_amax`` named scope — the amax
+    over a zero3-sharded master is a scalar all-reduce-max). bf16 arm:
+    no scales, no ring advance, bitwise-identical step.
     """
     if accum_steps < 1:
         raise ValueError(
             f"optim.accum_steps must be >= 1, got {accum_steps}")
+    lowp_arm = (lowp or {}).get("arm", "bf16")
 
     def step(state: TrainState, batch: dict, scalars: dict, rng: jax.Array):
         it = state.step
@@ -150,6 +168,15 @@ def make_train_step(
         # uninterrupted or restarted from a checkpoint (both rng paths)
         rng = jax.random.fold_in(rng, it)
         frozen = {k: v for k, v in state.params.items() if k != "student"}
+
+        fwd_lowp = None
+        if lowp_arm != "bf16" and state.lowp is not None:
+            from dinov3_tpu.ops.lowp import lowp_scales
+
+            fwd_lowp = {
+                k: lowp_scales(h, lowp_arm, lowp["scale_margin"])
+                for k, h in state.lowp.items()
+            }
 
         if accum_steps == 1:
             rngs = rng_plan = None
@@ -174,6 +201,7 @@ def make_train_step(
                     iteration=it,
                     rngs=rngs,
                     rng_plan=rng_plan,
+                    lowp=fwd_lowp,
                 )
 
         else:
@@ -222,6 +250,7 @@ def make_train_step(
                         rngs=rngs_j,
                         rng_plan=plan_j,
                         gather_params=False,
+                        lowp=fwd_lowp,
                     )
                     return loss_j, ld_j, nc_j
 
@@ -284,11 +313,20 @@ def make_train_step(
         new_params["student"] = new_student
         new_params["teacher"] = new_teacher
 
+        new_lowp = state.lowp
+        if fwd_lowp is not None:
+            # delayed scaling: the rings observe the UPDATED masters as
+            # part of the update epilogue (train/fused_update.py)
+            from dinov3_tpu.train.fused_update import lowp_state_step
+
+            new_lowp = lowp_state_step(state.lowp, new_student, new_teacher)
+
         new_state = TrainState(
             params=new_params,
             opt_state=new_opt_state,
             center_state=new_centers,
             step=it + 1,
+            lowp=new_lowp,
         )
         return new_state, metrics
 

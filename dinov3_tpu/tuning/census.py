@@ -122,9 +122,33 @@ KNOB_REGISTRY: dict = {
         "why": "pallas flash kernel key/value-tile cap — hardware "
                "tile alignment (MXU/VMEM), changed only with the "
                "kernel"},
+    # ---- train.low_precision (ops/lowp.py, PR 17) ----
+    "train.low_precision.arm": {
+        "kind": "justified",
+        "why": "precision-arm mode switch (bf16|fp8|int8), not a "
+               "magnitude — its cost story is COST_LP_r21.json and "
+               "the phQ on-chip A/B (scripts/r6_queue.sh)"},
+    "train.low_precision.amax_history_len": {
+        "kind": "justified",
+        "why": "delayed-scaling amax ring length — the Transformer "
+               "Engine default (16); a numerics-stability window, "
+               "not a latency knob (the ring is a few f32 scalars "
+               "per kernel)"},
+    "train.low_precision.scale_margin": {
+        "kind": "justified",
+        "why": "headroom multiplier on the history amax — overflow "
+               "insurance for between-step weight drift (numerics, "
+               "not latency); 1.0 = trust the one-step-delayed amax"},
+    "train.low_precision.divergence_tol": {
+        "kind": "justified",
+        "why": "warn_lowp_divergence gate on the setup drift probe — "
+               "an alerting threshold (rel. Frobenius), not a "
+               "schedule constant"},
 }
 
-CENSUS_SECTIONS = ("optim", "kernels")
+# Dotted entries ("train.low_precision") walk nested config nodes — the
+# census covers sub-blocks without sweeping every train.* key into it.
+CENSUS_SECTIONS = ("optim", "kernels", "train.low_precision")
 
 
 def _is_numeric(v) -> bool:
@@ -147,8 +171,13 @@ def knob_census(cfg=None) -> dict:
     entries = []
     unregistered = []
     seen = set()
+    present_sections = []
     for section in CENSUS_SECTIONS:
-        node = cfg.get(section) or {}
+        node = cfg
+        for part in section.split("."):
+            node = (node.get(part) or {}) if node else {}
+        if node:
+            present_sections.append(section)
         for key in node:
             value = node.get(key)
             name = f"{section}.{key}"
@@ -169,7 +198,12 @@ def knob_census(cfg=None) -> dict:
                 if opt in reg:
                     entry[opt] = reg[opt]
             entries.append(entry)
-    stale = sorted(set(KNOB_REGISTRY) - seen)
+    # staleness is scoped to the sections the given config actually
+    # carries: a partial/shadow config (tests census just optim+kernels)
+    # must not read the other sections' registry entries as stale
+    stale = sorted(
+        name for name in set(KNOB_REGISTRY) - seen
+        if any(name.startswith(s + ".") for s in present_sections))
     return {
         "ok": not unregistered and not stale,
         "n_knobs": len(entries),

@@ -459,6 +459,16 @@ HLO_COLLECTIVE_SCOPES = (
     ("zero3_prefetch", "zero3_prefetch"),
     ("zero3_stream", "zero3_stream"),
     ("zero3_gather", "zero3_gather"),
+    # train.low_precision (ops/lowp.py): lowp_amax = the delayed-scaling
+    # history advance + the activations' current-scale amax (under zero3
+    # each is a tiny all-reduce-max over a sharded master); lowp_dequant
+    # = the dequantize epilogue after each quantized matmul (normally
+    # collective-free — listed so any reshard GSPMD hangs there is
+    # attributed, not "other"). The quantized WEIGHT gathers themselves
+    # ride the zero3_stream scope above on purpose: same collective
+    # sites as the bf16 stream, 1-byte payloads.
+    ("lowp_amax", "lowp_amax"),
+    ("lowp_dequant", "lowp_dequant"),
     # the bucketed collective engine (train/fused_update.py
     # make_bucketed_update + the overlap twin in models/streaming.py):
     # pack = the coalesced grad reduce-scatter site, unpack = the

@@ -103,6 +103,19 @@
 #         scoped collective counts land next to the throughput delta —
 #         whether staging over the real TPU hierarchy (ICI vs DCN)
 #         pays is exactly the question the CPU artifact cannot answer.
+#   phQ   low-precision training arm A/B (PR 17, ops/lowp.py):
+#         treatment runs the fp8 arm (train.low_precision.arm=fp8 —
+#         block matmul kernels quantized per-tensor with delayed
+#         scaling, the zero3 stream gathering 1-byte codes instead of
+#         bf16); control is the identical zero3 dp x fsdp mesh on the
+#         default bf16 arm. Both arms carry BENCH_CENSUS=1 so the
+#         streamed-gather scope counts + the record's "low_precision"
+#         block (arm, setup drift probe, lowp_amax/lowp_dequant
+#         scopes) land next to the throughput delta. Host-side
+#         accounting (scripts/cost_lowp.py, COST_LP_r21.json):
+#         >=1.8x fewer streamed kernel-gather bytes at identical
+#         collective counts; XLA:CPU emulates fp8/int8 dots by
+#         upconversion, so only this run prices the speed.
 #   phG2  fixed op-level flash-vs-dense attention crossover
 #         (scripts/crossover_attention.py): the
 #         kernels.flash_min_seq=2048 boundary is measured only at
@@ -372,6 +385,20 @@ run_bench phN_unified_perleaf_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=parallel.fsdp=2,parallel.zero3=true,optim.bucketed_collectives=false,train.scan_layers=true
 run_bench phN_unified_accum2 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=parallel.fsdp=2,parallel.zero3=true,optim.bucketed_collectives=true,optim.accum_steps=2,train.scan_layers=true
+
+# phQ: low-precision training arm A/B (PR 17). Both arms pin the SAME
+# dp x fsdp=2 zero3 mesh + scanned stack so the only difference is the
+# precision arm: treatment quantizes the block matmul kernels to fp8
+# (delayed per-tensor scaling; the in-loop zero3 stream gathers 1-byte
+# codes), control is the committed bf16 default (bitwise the PR-16
+# program). The censuses carry the zero3_stream/lowp_* scope counts so
+# the bytes-vs-counts story lands next to the throughput delta — the
+# CPU artifact (COST_LP_r21.json) prices the bytes, only the chip's
+# native fp8 matmul unit prices the speed.
+run_bench phQ_lowp_fp8 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=parallel.fsdp=2,parallel.zero3=true,train.scan_layers=true,train.low_precision.arm=fp8
+run_bench phQ_lowp_bf16_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
+    BENCH_OVERRIDES=parallel.fsdp=2,parallel.zero3=true,train.scan_layers=true
 
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including
