@@ -478,6 +478,38 @@ def _serve_summary(engine, copy_census=None) -> dict:
     return out
 
 
+def _distill_summary(setup, coll_census) -> dict:
+    """The record's "distill" block: whether the benched step distills
+    from a frozen teacher, which teacher arm feeds it (in_step = the
+    teacher forwards inside the compiled step; serve = the host-shared
+    packed engine's precomputed batch planes), and — when this process
+    built shared TeacherServers (multidistillation.shared_teacher_server)
+    — each server's forward-dedup/cache/compile counters, the numbers
+    COST_DISTILL_r22.json pins. Census runs add the ``distill_fanout``
+    scope counts of the exact benched program."""
+    meta = getattr(setup, "meta", None)
+    out = {
+        "arm": bool(getattr(meta, "distillation", False)),
+        "teacher_source": getattr(meta, "teacher_source", "in_step"),
+        "teacher_embed_dim": (getattr(meta, "teacher_embed_dim", None)
+                              if getattr(meta, "distillation", False)
+                              else None),
+    }
+    try:
+        from dinov3_tpu.train.multidistillation import _SHARED_TEACHERS
+
+        if _SHARED_TEACHERS:
+            out["teacher_servers"] = [s.stats()
+                                      for s in _SHARED_TEACHERS.values()]
+    except ImportError:
+        pass
+    if coll_census and "by_scope" in coll_census:
+        out["collectives_by_scope"] = {
+            k: v for k, v in coll_census["by_scope"].items()
+            if k.startswith("distill")}
+    return out
+
+
 def _fleet_summary(router) -> dict:
     """The record's "fleet" block (serve/fleet.py FleetRouter): one
     entry per pool engine — arm, weights dtype, token-budget shape,
@@ -1038,6 +1070,11 @@ def main():
         # the census ran — the streamed-gather + dequant-epilogue scope
         # counts of the exact benched program (the phQ A/B instrument)
         "low_precision": _lowp_summary(setup, coll_census),
+        # distillation summary: whether the step distills and through
+        # which teacher arm (in_step vs the serve-backed fan-out), any
+        # process-level TeacherServer dedup/cache counters, and — when
+        # the census ran — the distill_fanout scope counts
+        "distill": _distill_summary(setup, coll_census),
         # tuned-plan provenance (tuning/plan.py): artifact path +
         # fingerprint, and per schedule knob the configured value, the
         # resolved value, and its source (tuned / explicit / fallback)

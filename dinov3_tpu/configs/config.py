@@ -918,12 +918,53 @@ def serve_cache_wished(cfg: ConfigNode) -> bool:
     return bool(e)
 
 
-def serve_cache_entry_bytes(embed_dim: int) -> int:
+def serve_patch_features_wished(cfg: ConfigNode) -> bool:
+    """Whether the serve engines extract the per-token patch plane
+    (serve/engine.py ServeRing.patch + ServeResponse.patch_tokens).
+    ``serve.patch_features``: OPT-IN — false (default) keeps the ring
+    at the CLS+pool payload; true/on widens the ring by a
+    [depth, R, N, D] f32 plane and every response carries its token
+    span. Opt-in because the plane multiplies the per-pack fetch bytes
+    by ~row_tokens/segments; the distillation TeacherServer
+    (train/distillation.py) forces it on for its own engine regardless
+    of this key — the iBOT loss needs tokens, not pools."""
+    pf = (cfg.get("serve") or {}).get("patch_features", False)
+    if isinstance(pf, str):
+        return pf.lower() in ("true", "on", "1")
+    return bool(pf)
+
+
+def distill_teacher_source(cfg: ConfigNode) -> str:
+    """Resolved ``distillation.teacher_source`` — where the frozen
+    teacher's features come from under distillation:
+
+    - ``in_step`` (default): the teacher backbone forwards INSIDE the
+      compiled train step, once per student subgroup per step — the
+      bitwise oracle the serve arm is pinned against
+      (tests/test_distill_serve.py, COST_DISTILL_r22.json);
+    - ``serve``: the host-shared packed AOT teacher engine
+      (train/distillation.py TeacherServer) computes CLS+patch features
+      ONCE per image, the content-addressed cache absorbs repeats, and
+      the train step consumes them as ``teacher_cls``/
+      ``teacher_patches`` batch planes (ssl_meta_arch.py
+      get_teacher_output precomputed arm, ``distill_fanout`` scope).
+    """
+    d = cfg.get("distillation") or {}
+    ts = str(d.get("teacher_source", "in_step") or "in_step").lower()
+    if ts not in ("in_step", "serve"):
+        raise ValueError(
+            f"distillation.teacher_source={ts!r}: expected in_step|serve")
+    return ts
+
+
+def serve_cache_entry_bytes(embed_dim: int, patch_tokens: int = 0) -> int:
     """Feature payload bytes of ONE cache entry: the CLS and pooled
-    [D] float32 vectors (serve/cache.py values; keys and LRU
+    [D] float32 vectors, plus the [T, D] f32 patch plane when the
+    engine serves per-token features (``patch_tokens`` = T, 0 on the
+    default CLS+pool path — serve/cache.py values; keys and LRU
     bookkeeping are O(100) bytes and excluded — the budget guardrail
     is about the feature planes)."""
-    return 2 * int(embed_dim) * 4
+    return (2 + int(patch_tokens)) * int(embed_dim) * 4
 
 
 def warn_quant_drift(
@@ -956,21 +997,24 @@ def warn_quant_drift(
 def warn_cache_memory(
     capacity: int, embed_dim: int, budget_mb: float = 1024.0,
     threshold: float = 1.0, stacklevel: int = 2,
-    axis: str = "serve feature cache",
+    axis: str = "serve feature cache", patch_tokens: int = 0,
 ) -> str | None:
     """Warn when the cache's worst-case feature bytes — capacity x
     ``serve_cache_entry_bytes`` — exceed ``threshold`` x the host
     budget (``serve.cache.host_budget_mb``). Fired at fleet build
-    (serve/fleet.py) and from ``load_config`` so an oversized capacity
-    never waits for the LRU to fill before anyone notices. Returns the
-    message or None."""
-    need_mb = int(capacity) * serve_cache_entry_bytes(embed_dim) / 2**20
+    (serve/fleet.py), from ``load_config`` so an oversized capacity
+    never waits for the LRU to fill before anyone notices, and at
+    TeacherServer build (train/distillation.py) with the per-token
+    ``patch_tokens`` term — patch entries are ~T/2 x bigger than
+    CLS+pool entries. Returns the message or None."""
+    entry = serve_cache_entry_bytes(embed_dim, patch_tokens)
+    need_mb = int(capacity) * entry / 2**20
     if budget_mb <= 0 or need_mb <= threshold * budget_mb:
         return None
     msg = (
         f"cache memory axis [{axis}]: serve.cache.capacity={capacity} "
-        f"x {serve_cache_entry_bytes(embed_dim)} B/entry (embed_dim "
-        f"{embed_dim}) = {need_mb:.0f} MB of feature payload at full "
+        f"x {entry} B/entry (embed_dim {embed_dim}, patch_tokens "
+        f"{patch_tokens}) = {need_mb:.0f} MB of feature payload at full "
         f"occupancy, over the serve.cache.host_budget_mb={budget_mb:.0f} "
         f"budget. Lower the capacity or raise the budget "
         f"(serve/cache.py)."

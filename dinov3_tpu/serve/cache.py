@@ -70,10 +70,15 @@ class FeatureCache:
     """Bounded LRU of computed features, keyed content-addressed.
 
     Values are ``(cls_feature, pooled_patch_feature, n_patches)`` —
-    the response payload minus per-request metadata. ``get`` refreshes
-    recency; ``put`` evicts the least-recently-used entry past
-    ``capacity`` and returns whether it evicted (the router forwards
-    that to the observer's eviction counter)."""
+    the response payload minus per-request metadata — or the 4-tuple
+    ``(cls, pooled, n_patches, patch_tokens)`` when the serving engine
+    extracts per-token features (the distillation TeacherServer; the
+    [T, D] plane dominates the entry size, so ``warn_cache_memory``
+    budgets must account for it via ``serve_cache_entry_bytes``'s
+    ``patch_tokens`` term). ``get`` refreshes recency; ``put`` evicts
+    the least-recently-used entry past ``capacity`` and returns whether
+    it evicted (the router forwards that to the observer's eviction
+    counter)."""
 
     def __init__(self, capacity: int):
         capacity = int(capacity)
@@ -105,14 +110,19 @@ class FeatureCache:
         """Insert (or refresh) one entry; True when an LRU eviction made
         room. Stored arrays are frozen (writeable=False) so a caller
         mutating a hit response cannot poison later hits."""
-        cls, pooled, n_patches = value
+        cls, pooled, n_patches = value[:3]
         cls = np.asarray(cls)
         pooled = np.asarray(pooled)
         cls.flags.writeable = False
         pooled.flags.writeable = False
+        stored = (cls, pooled, int(n_patches))
+        if len(value) > 3 and value[3] is not None:
+            patch = np.asarray(value[3])
+            patch.flags.writeable = False
+            stored = stored + (patch,)
         if key in self._d:
             self._d.move_to_end(key)
-        self._d[key] = (cls, pooled, int(n_patches))
+        self._d[key] = stored
         self.inserts += 1
         if len(self._d) > self.capacity:
             self._d.popitem(last=False)

@@ -43,10 +43,20 @@ class ServeRing(NamedTuple):
     observability plane reads in the SAME fetch as the features, so
     device-side serve stats cost zero extra blocking syncs. Depth 2 =
     double buffering — slot t is fetched while the buffers for slot t+1
-    are already owned by the next dispatch."""
+    are already owned by the next dispatch.
+
+    ``patch`` is the per-token plane ([depth, R, N, D] f32): the full
+    patch-normed rows of the packed forward, written only when the
+    engine is built with ``patch_features=True`` (the serve-backed
+    distillation teacher needs per-token features for the iBOT loss,
+    not just the mean pool). With patch features off the plane is
+    zero-width ([depth, R, 0, D]) so the ring's pytree structure — and
+    with it the donation contract of the ONE compiled program — is
+    identical across both arms."""
 
     cls: jnp.ndarray
     pooled: jnp.ndarray
+    patch: jnp.ndarray
     stats: jnp.ndarray
 
 
@@ -56,15 +66,18 @@ class ServeRing(NamedTuple):
 SERVE_STATS_FIELDS = ("tokens_used", "n_segments", "pad_tokens", "stamp")
 
 
-def make_serve_ring(depth: int, rows: int, n_slots: int, embed_dim: int):
+def make_serve_ring(depth: int, rows: int, n_slots: int, embed_dim: int,
+                    patch_tokens: int = 0):
     shape = (depth, rows, n_slots, embed_dim)
     return ServeRing(cls=jnp.zeros(shape, jnp.float32),
                      pooled=jnp.zeros(shape, jnp.float32),
+                     patch=jnp.zeros((depth, rows, patch_tokens, embed_dim),
+                                     jnp.float32),
                      stats=jnp.zeros((depth, len(SERVE_STATS_FIELDS)),
                                      jnp.float32))
 
 
-def make_serve_step(model, n_slots: int):
+def make_serve_step(model, n_slots: int, patch_features: bool = False):
     """The jitted serve step: packed planes -> per-segment features,
     written in place into the donated ring at ``slot``.
 
@@ -104,6 +117,16 @@ def make_serve_step(model, n_slots: int):
             pooled = jnp.einsum("rsn,rnd->rsd", sel, patch_rows)
             counts = sel.sum(-1)
             pooled = pooled / jnp.maximum(counts, 1.0)[..., None]
+        patch_plane = ring.patch
+        if patch_features:
+            # distillation fan-out: the full patch-normed rows land in
+            # the ring beside the CLS/pooled planes — the SAME forward,
+            # the same one-fetch discipline, just a wider payload. The
+            # scope attributes any GSPMD copies/reshards this write
+            # induces to the fan-out in the collective census.
+            with jax.named_scope("distill_fanout"):
+                patch_plane = jax.lax.dynamic_update_slice(
+                    ring.patch, patch_rows[None], (slot, 0, 0, 0))
         with jax.named_scope("serve_ring"):
             tokens_used = (seg >= 0).sum().astype(jnp.float32)
             n_segments = (counts > 0).sum().astype(jnp.float32)
@@ -116,6 +139,7 @@ def make_serve_step(model, n_slots: int):
                     ring.cls, cls[None], (slot, 0, 0, 0)),
                 pooled=jax.lax.dynamic_update_slice(
                     ring.pooled, pooled[None], (slot, 0, 0, 0)),
+                patch=patch_plane,
                 stats=jax.lax.dynamic_update_slice(
                     ring.stats, stats_row[None], (slot, 0)),
             )
@@ -129,7 +153,7 @@ class PackedServeEngine:
 
     def __init__(self, model, params, layout: ServeLayout,
                  flush_ms: float = 10.0, ring_depth: int = 2,
-                 warn: bool = True):
+                 warn: bool = True, patch_features: bool = False):
         from dinov3_tpu.configs.config import (
             serve_pad_waste_floor,
             warn_serve_pad_waste,
@@ -146,10 +170,15 @@ class PackedServeEngine:
                     else "packed")
         self.batcher = ContinuousBatcher(layout, flush_ms=flush_ms)
         self.ring_depth = int(ring_depth)
+        # per-token feature serving (serve.patch_features / the
+        # distillation TeacherServer): the ring grows a [depth, R, N, D]
+        # patch plane and every response carries its token span
+        self.patch_features = bool(patch_features)
         self._slot = 0
         self._ring = make_serve_ring(
             self.ring_depth, layout.rows, layout.max_segments_per_row,
-            model.embed_dim)
+            model.embed_dim,
+            patch_tokens=layout.row_tokens if self.patch_features else 0)
         if warn:
             floor = serve_pad_waste_floor(
                 layout.row_tokens, layout.patch_size, layout.n_prefix,
@@ -166,7 +195,8 @@ class PackedServeEngine:
         # the one compile: AOT lower + compile at build, so serving can
         # never silently re-trace (a mismatched plane shape is an error,
         # not a second program)
-        step = make_serve_step(model, layout.max_segments_per_row)
+        step = make_serve_step(model, layout.max_segments_per_row,
+                               patch_features=self.patch_features)
         jitted = jax.jit(step, donate_argnums=donation_safe_argnums((1,)))
         abstract = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
@@ -276,18 +306,34 @@ class PackedServeEngine:
         self._waste_total += self.layout.token_budget
         # ONE blocking fetch per pack — the stats row rides it, so the
         # observability plane adds zero device syncs (funnel-pinned in
-        # tests/test_obs.py and the OBS artifact)
-        cls, pooled, stats = blocking_fetch(
-            (self._ring.cls[slot], self._ring.pooled[slot],
-             self._ring.stats[slot]))
+        # tests/test_obs.py and the OBS artifact). The patch plane, when
+        # served, rides the SAME fetch: a wider payload, not a second
+        # sync.
+        fetch = (self._ring.cls[slot], self._ring.pooled[slot],
+                 self._ring.stats[slot])
+        if self.patch_features:
+            fetch = fetch + (self._ring.patch[slot],)
+        fetched = blocking_fetch(fetch)
+        cls, pooled, stats = fetched[:3]
+        patch_plane = fetched[3] if self.patch_features else None
         t_fetch1 = time.perf_counter()
         out = []
+        npfx = self.layout.n_prefix
         for pl in plan.placements:
+            patch_tokens = None
+            if patch_plane is not None:
+                # the request's tokens are the contiguous packed span
+                # [offset + n_prefix, offset + n_prefix + n_patches)
+                # of its row (batcher.py plane layout)
+                a = pl.offset + npfx
+                patch_tokens = np.asarray(
+                    patch_plane[pl.row, a:a + pl.n_patches])
             out.append(ServeResponse(
                 request_id=pl.request.request_id,
                 cls_feature=np.asarray(cls[pl.row, pl.slot]),
                 pooled_patch_feature=np.asarray(pooled[pl.row, pl.slot]),
                 n_patches=pl.n_patches,
+                patch_tokens=patch_tokens,
                 arrival_s=pl.request.arrival_s,
                 slo=pl.request.slo,
             ))
@@ -320,7 +366,8 @@ class OracleServeEngine:
     packed engine removes."""
 
     def __init__(self, model, params, layout: ServeLayout,
-                 flush_ms: float = 10.0, mode: str = "rectangular"):
+                 flush_ms: float = 10.0, mode: str = "rectangular",
+                 patch_features: bool = False):
         if mode not in ("per_image", "rectangular"):
             raise ValueError(
                 f"serve.oracle={mode!r}: expected per_image|rectangular")
@@ -329,6 +376,7 @@ class OracleServeEngine:
         self.layout = layout
         self.mode = mode
         self.arm = f"oracle_{mode}"
+        self.patch_features = bool(patch_features)
         self.batcher = ContinuousBatcher(layout, flush_ms=flush_ms)
         self.packs_run = 0
         self.last_pad_waste = 0.0
@@ -339,8 +387,10 @@ class OracleServeEngine:
         def feats(p, x):
             out = model.apply({"params": p}, x, crop_kind="global",
                               deterministic=True)
+            patches = out["x_norm_patchtokens"].astype(jnp.float32)
             return (out["x_norm_clstoken"].astype(jnp.float32),
-                    out["x_norm_patchtokens"].astype(jnp.float32).mean(1))
+                    patches.mean(1),
+                    patches if self.patch_features else None)
 
         self._feat = jax.jit(feats)
 
@@ -398,7 +448,7 @@ class OracleServeEngine:
             t0 = time.perf_counter()
             pending = self._feat(self.params, jnp.asarray(x))
             t1 = time.perf_counter()
-            cls, pooled = blocking_fetch(pending)
+            cls, pooled, patches = blocking_fetch(pending)
             dispatch_ms += (t1 - t0) * 1e3
             fetch_ms += (time.perf_counter() - t1) * 1e3
             seq = self.layout.seq_len(*group[0].hw)
@@ -409,6 +459,8 @@ class OracleServeEngine:
                     request_id=r.request_id, cls_feature=cls[i],
                     pooled_patch_feature=pooled[i],
                     n_patches=seq - self.layout.n_prefix,
+                    patch_tokens=(np.asarray(patches[i])
+                                  if patches is not None else None),
                     arrival_s=r.arrival_s, slo=r.slo))
         self.last_pad_waste = 1.0 - used / padded if padded else 0.0
         self._waste_used += used
@@ -479,15 +531,20 @@ def build_serve_engine(cfg, params=None, ckpt_dir: str | None = None,
     from dinov3_tpu.configs.config import continuous_packing_wished
     from dinov3_tpu.serve.weights import load_serving_model
 
+    from dinov3_tpu.configs.config import serve_patch_features_wished
+
     model, sparams = load_serving_model(cfg, ckpt_dir=ckpt_dir,
                                         params=params)
     layout = serve_layout_from_cfg(cfg, model)
     s = cfg.get("serve") or {}
     flush_ms = float(s.get("flush_ms", 10.0) or 10.0)
+    patch_features = serve_patch_features_wished(cfg)
     if continuous_packing_wished(cfg):
         return PackedServeEngine(
             model, sparams, layout, flush_ms=flush_ms,
-            ring_depth=int(s.get("ring_depth", 2) or 2), warn=warn)
+            ring_depth=int(s.get("ring_depth", 2) or 2), warn=warn,
+            patch_features=patch_features)
     return OracleServeEngine(
         model, sparams, layout, flush_ms=flush_ms,
-        mode=str(s.get("oracle", "rectangular") or "rectangular"))
+        mode=str(s.get("oracle", "rectangular") or "rectangular"),
+        patch_features=patch_features)

@@ -45,6 +45,11 @@ class ServeResponse:
     cls_feature: np.ndarray
     pooled_patch_feature: np.ndarray
     n_patches: int
+    # per-token patch features [n_patches, D] f32 — populated only by
+    # engines built with ``patch_features=True`` (the serve-backed
+    # distillation teacher consumes these for the iBOT loss); None on
+    # the default CLS+pool serving path
+    patch_tokens: np.ndarray | None = None
     arrival_s: float = 0.0
     done_s: float = 0.0
     slo: str = "default"

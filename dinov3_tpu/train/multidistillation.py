@@ -122,3 +122,55 @@ def setup_multidistillation(
         group_rank=groups[mine].index(rank),
         output_dir=output_dir,
     )
+
+
+# ---------------- the shared teacher plane ----------------
+#
+# Every student subgroup distills from the SAME frozen teacher over the
+# SAME dataset — the k x redundant teacher forward ROADMAP item 2 calls
+# the single largest redundant compute in the recipe. The fan-out fix is
+# a process-level registry: the first student group to ask builds the
+# packed AOT teacher engine + content-addressed cache
+# (train/distillation.py TeacherServer), every later group with the same
+# teacher (config path + weights + crop size) gets the SAME instance —
+# one teacher evaluation per image per host, k students or not
+# (tests/test_distill_serve.py two-subgroup dryrun;
+# COST_DISTILL_r22.json).
+
+_SHARED_TEACHERS: dict = {}
+
+
+def _teacher_key(cfg, teacher_params, ckpt_dir) -> tuple:
+    if teacher_params is not None:
+        from dinov3_tpu.serve.cache import weights_fingerprint
+
+        src = weights_fingerprint(teacher_params)
+    else:
+        src = str(ckpt_dir)
+    return (str(cfg.distillation.full_cfg_path), src,
+            int(cfg.crops.global_crops_size))
+
+
+def shared_teacher_server(cfg, teacher_params=None,
+                          ckpt_dir: str | None = None, warn: bool = True):
+    """The process-level TeacherServer for this teacher: built once,
+    then shared by every co-hosted student subgroup (and every epoch).
+    Keyed on (teacher config path, weights fingerprint or checkpoint
+    dir, global crop size) — two students of DIFFERENT teachers, or the
+    same teacher at a different crop size, get separate engines."""
+    from dinov3_tpu.train.distillation import TeacherServer
+
+    key = _teacher_key(cfg, teacher_params, ckpt_dir)
+    server = _SHARED_TEACHERS.get(key)
+    if server is None:
+        server = TeacherServer(cfg, teacher_params=teacher_params,
+                               ckpt_dir=ckpt_dir, warn=warn)
+        _SHARED_TEACHERS[key] = server
+        logger.info(
+            "distillation: built shared teacher server (fingerprint %s, "
+            "compile %.1fs)", server.fingerprint, server.engine.compile_s)
+    else:
+        logger.info(
+            "distillation: reusing shared teacher server (fingerprint %s)",
+            server.fingerprint)
+    return server

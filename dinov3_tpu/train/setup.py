@@ -224,6 +224,16 @@ def _build_train_setup(
                 "stream the quantized gathers ride."
             )
     meta = SSLMetaArch(cfg)
+    if meta.teacher_source == "serve" and "teacher_cls" not in example_batch:
+        # the serve-backed teacher arm changes the STEP SIGNATURE: the
+        # precomputed teacher planes are batch inputs (batch-sharded by
+        # batch_specs below), so the trace batch must carry them —
+        # train.py composes the example with teacher_feature_example
+        # zeros; fail at setup, not at the first dispatch
+        raise ValueError(
+            "distillation.teacher_source=serve: example_batch must carry "
+            "teacher_cls/teacher_patches planes "
+            "(train/distillation.py teacher_feature_example)")
     schedules = build_schedules(cfg)
 
     # Optimizer multiplier trees need only the param paths/shapes: derive

@@ -88,6 +88,18 @@ class SSLMetaArch:
             teacher_cfg, teacher=True, param_dtype=self.policy.param_dtype)
         self.embed_dim = self.student_backbone.embed_dim
         self.teacher_embed_dim = self.teacher_backbone.embed_dim
+        # Teacher feature source (configs/config.py
+        # distill_teacher_source): "in_step" (default) keeps the frozen
+        # teacher's backbone forward inside the compiled step — the
+        # bitwise oracle; "serve" consumes teacher_cls/teacher_patches
+        # batch planes precomputed ONCE per image by the host-shared
+        # packed teacher engine (train/distillation.py TeacherServer,
+        # ``distill_fanout`` scope). Only meaningful under distillation
+        # — the EMA teacher changes every step and cannot be served.
+        from dinov3_tpu.configs.config import distill_teacher_source
+
+        self.teacher_source = (
+            distill_teacher_source(cfg) if self.distillation else "in_step")
 
         head_kw = dict(
             dtype=self.policy.compute_dtype,
@@ -478,19 +490,60 @@ class SSLMetaArch:
             patch_tokens, mask_indices[..., None], axis=1
         )
 
+    def teacher_backbone_features(self, teacher_params, batch, lowp=None):
+        """The frozen teacher's backbone forward over the global crops:
+        (cls [2B, D_t], patches [2B, T, D_t]), both in compute dtype.
+        This is the piece the serve-backed teacher arm computes OUTSIDE
+        the step (once per image, fanned out to every student subgroup);
+        everything downstream of it — heads, centering, target specs —
+        is shared with the in-step oracle via
+        ``teacher_targets_from_features``, which is what makes the two
+        arms bitwise-comparable."""
+        out = self._apply_backbone(
+            self.teacher_backbone, teacher_params["backbone"],
+            batch["global_crops"], crop_kind="global", train=False,
+            lowp=lowp,
+        )
+        return out["x_norm_clstoken"], out["x_norm_patchtokens"]
+
     def get_teacher_output(
         self, teacher_params, batch, teacher_temp, state, update_centers=True,
         lowp=None,
     ):
-        g = batch["global_crops"]
-        n_g = 2
-        B = g.shape[0] // n_g
-        out = self._apply_backbone(
-            self.teacher_backbone, teacher_params["backbone"], g,
-            crop_kind="global", train=False, lowp=lowp,
+        if self.teacher_source == "serve":
+            if "teacher_cls" not in batch or "teacher_patches" not in batch:
+                raise ValueError(
+                    "distillation.teacher_source=serve needs teacher_cls/"
+                    "teacher_patches batch planes (train/distillation.py "
+                    "TeacherServer.annotate; teacher_feature_example for "
+                    "the trace batch)")
+            # precomputed-targets arm: features were computed ONCE by
+            # the host-shared packed teacher engine and ride the batch
+            # as f32 planes; cast back to the compute dtype the in-step
+            # backbone emits (f32 storage of bf16 values round-trips
+            # exactly, so feeding the oracle's own features through
+            # here is bitwise — COST_DISTILL_r22.json's equivalence pin)
+            with jax.named_scope("distill_fanout"):
+                dt = self.policy.compute_dtype
+                cls = batch["teacher_cls"].astype(dt)
+                patches = batch["teacher_patches"].astype(dt)
+        else:
+            cls, patches = self.teacher_backbone_features(
+                teacher_params, batch, lowp=lowp)
+        return self.teacher_targets_from_features(
+            teacher_params, cls, patches, batch, teacher_temp, state,
+            update_centers,
         )
-        cls = out["x_norm_clstoken"]  # [2B, D_t]
-        patches = out["x_norm_patchtokens"]  # [2B, T, D_t]
+
+    def teacher_targets_from_features(
+        self, teacher_params, cls, patches, batch, teacher_temp, state,
+        update_centers=True,
+    ):
+        """Teacher targets from already-computed backbone features —
+        the shared tail of both teacher arms (heads -> centering ->
+        target specs). ``cls`` [2B, D_t], ``patches`` [2B, T, D_t]."""
+        n_g = 2
+        B = cls.shape[0] // n_g
         cls_logits = self.teacher_dino_head.apply(
             {"params": teacher_params["dino_head"]}, cls
         )  # [2B, K]

@@ -179,6 +179,15 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     data_iter = build_data_iterator(cfg, B, rank=rank, world_size=world,
                                     start_iter=start_iter)
     first = next(data_iter)
+    # serve-backed teacher (distillation.teacher_source=serve): the
+    # frozen teacher forwards OUTSIDE the step — a host-shared packed
+    # AOT engine + content-addressed cache (train/distillation.py
+    # TeacherServer) computes CLS+patch planes once per image and the
+    # step consumes them as teacher_cls/teacher_patches batch inputs
+    from dinov3_tpu.configs.config import distill_teacher_source
+
+    serve_teacher = (cfg.distillation.enabled
+                     and distill_teacher_source(cfg) == "serve")
     # setup traces with *global* shapes; the example's values never reach
     # the trained params (init depends only on the rng), so a zeros batch
     # keeps the traced constant identical across hosts
@@ -189,6 +198,13 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         }
     else:
         example = {k: jnp.asarray(v) for k, v in first.items()}
+    if serve_teacher:
+        from dinov3_tpu.train.distillation import teacher_feature_example
+
+        example.update({
+            k: jnp.asarray(v) for k, v in teacher_feature_example(
+                cfg, int(example["global_crops"].shape[0])).items()
+        })
     t0 = time.perf_counter()
     setup = build_train_setup(cfg, example, devices=devices)
     # the bucketed collective engine keeps adam moments in the bucket
@@ -203,8 +219,19 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     if args.self_check:
         from dinov3_tpu.train.self_check import run_self_check
 
+        check_batch = first
+        if serve_teacher:
+            # self-check runs pre-restore (random teacher weights):
+            # zero teacher planes exercise the mechanics without
+            # building a server around weights nobody will train with
+            from dinov3_tpu.train.distillation import (
+                teacher_feature_example,
+            )
+
+            check_batch = {**first, **teacher_feature_example(
+                cfg, int(first["global_crops"].shape[0]))}
         results = run_self_check(
-            setup, put_batch(first, setup.batch_shardings),
+            setup, put_batch(check_batch, setup.batch_shardings),
             jax.random.key(cfg.train.seed + 1),
         )
         return {"self_check_failures": sum(not v for v in results.values()),
@@ -259,6 +286,26 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         from dinov3_tpu.train.gram_refresh import load_gram_teacher
 
         state = load_gram_teacher(cfg, state, setup.state_shardings)
+
+    teacher_server = None
+    if serve_teacher:
+        # process-level shared server (multidistillation.py): co-hosted
+        # student subgroups with the same teacher get ONE engine + ONE
+        # cache — one teacher forward per image per host, k students or
+        # not. From a checkpoint the server restores host-side (each
+        # host replicates the serving tree — no cross-host gather);
+        # otherwise it serves the state's restored teacher backbone.
+        from dinov3_tpu.train.multidistillation import shared_teacher_server
+
+        if cfg.distillation.checkpoint_path:
+            teacher_server = shared_teacher_server(
+                cfg, ckpt_dir=cfg.distillation.checkpoint_path)
+        else:
+            teacher_server = shared_teacher_server(
+                cfg, teacher_params=jax.device_get(
+                    state.params["teacher"]["backbone"]))
+        logger.info("distillation: serve-backed teacher %s",
+                    teacher_server.stats())
 
     prof = None
     if args.profile_steps:
@@ -381,6 +428,8 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
                 f"aborting: {streak} consecutive non-finite losses"
             )
 
+    if teacher_server is not None:
+        first = teacher_server.annotate(first)
     pending = put_batch(first, setup.batch_shardings)
     for it, raw in metric_logger.log_every(
         tracer.wrap_iter(data_iter, start_iteration=start_iter),
@@ -398,6 +447,13 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
             else:
                 state, metrics = setup.step_fn(
                     state, batch, setup.scalars(it), rng)
+        if teacher_server is not None:
+            # the shared teacher's serve pass for the NEXT batch runs
+            # while this step computes on device — cache hits are O(µs)
+            # host lookups, misses one packed AOT dispatch; the span
+            # makes the overlap (or lack of it) measurable
+            with tracer.span("teacher_serve", it):
+                raw = teacher_server.annotate(raw)
         with tracer.span("h2d", it):
             # overlap next batch's host->device transfer with this step
             pending = put_batch(raw, setup.batch_shardings)
@@ -542,6 +598,9 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     tracer.close()
     ckpt.close()
     result = {"final_loss": last_loss, "iterations": int(state.step)}
+    if teacher_server is not None:
+        result["teacher_serve"] = teacher_server.stats()
+        logger.info("serve-backed teacher: %s", result["teacher_serve"])
     if recorder is not None:
         recorder.close()
         logger.info("recorded losses to %s", args.record_losses)

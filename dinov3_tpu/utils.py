@@ -490,6 +490,12 @@ HLO_COLLECTIVE_SCOPES = (
     # permute scope nests inside the boundary scope.
     ("ring_permute", "ring_permute"),
     ("ring_merge", "ring_merge"),
+    # serve-backed distillation fan-out (serve/engine.py patch-plane
+    # ring write; ssl_meta_arch.py get_teacher_output's precomputed
+    # arm): the teacher_cls/teacher_patches batch planes enter the step
+    # replicated-per-host and GSPMD reshards them onto the batch axes —
+    # those copies/collectives belong to the fan-out, not "other"
+    ("distill_fanout", "distill_fanout"),
     ("telemetry_ring", "telemetry"),
 )
 

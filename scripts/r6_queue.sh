@@ -116,6 +116,21 @@
 #         >=1.8x fewer streamed kernel-gather bytes at identical
 #         collective counts; XLA:CPU emulates fp8/int8 dots by
 #         upconversion, so only this run prices the speed.
+#   phD   serve-backed distillation teacher A/B (PR 18,
+#         train/distillation.py TeacherServer): treatment runs the
+#         real trainer with distillation.teacher_source=serve — the
+#         frozen teacher forwards ONCE per unique image in the
+#         host-shared packed AOT engine and the train step consumes
+#         the precomputed teacher_cls/teacher_patches batch planes;
+#         control is the identical run with teacher_source=in_step
+#         (the teacher forward inside every compiled step — the
+#         bitwise oracle). Same synthetic stream, same init, both
+#         benchmark windows after warmup. CPU-side accounting
+#         (scripts/cost_distill.py, COST_DISTILL_r22.json): k*E fewer
+#         teacher forwards at 1 engine compile and bitwise
+#         precomputed-vs-oracle targets; this measures whether the
+#         host-side serve round-trip beats the in-step forward the
+#         chip executes for free while the student waits.
 #   phG2  fixed op-level flash-vs-dense attention crossover
 #         (scripts/crossover_attention.py): the
 #         kernels.flash_min_seq=2048 boundary is measured only at
@@ -399,6 +414,48 @@ run_bench phQ_lowp_fp8 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=parallel.fsdp=2,parallel.zero3=true,train.scan_layers=true,train.low_precision.arm=fp8
 run_bench phQ_lowp_bf16_ctl 2100 pinned BENCH_PROBS=bf16 BENCH_CENSUS=1 \
     BENCH_OVERRIDES=parallel.fsdp=2,parallel.zero3=true,train.scan_layers=true
+
+# phD: serve-backed distillation teacher A/B (PR 18). Both arms run
+# the REAL trainer (synthetic stream, default ViT-L distilling from
+# its own recipe as the frozen teacher — weights are random either
+# way, only the teacher-evaluation PATH differs) with a benchmark
+# window: treatment = teacher_source=serve (one packed host-side
+# teacher forward per unique image, planes ride the batch), control =
+# teacher_source=in_step (the teacher forward inside every compiled
+# step). The treatment result embeds the TeacherServer dedup/cache
+# counters the CPU artifact pins.
+if gate_phase 3000 phD_distill_serve; then
+    note "start phD_distill_serve"
+    printf '{}\n' > /tmp/phD_teacher.yaml
+    for arm in serve in_step; do
+        rm -rf "/tmp/phD_$arm"
+        if timeout 3000 python - "$arm" > "/tmp/phD_$arm.json" 2>>"$LOG" <<'PY'
+import json, sys
+from dinov3_tpu.train.train import main
+
+arm = sys.argv[1]
+res = main([
+    "--output-dir", f"/tmp/phD_{arm}", "--no-resume",
+    "--max-iterations", "40", "--benchmark", "20",
+    "data.backend=synthetic",
+    "distillation.enabled=true",
+    "distillation.full_cfg_path=/tmp/phD_teacher.yaml",
+    f"distillation.teacher_source={arm}",
+])
+keep = ("img_per_sec", "final_loss", "iterations", "teacher_serve")
+print(json.dumps({"arm": arm,
+                  **{k: res[k] for k in keep if k in res}}))
+PY
+        then
+            line=$(cat "/tmp/phD_$arm.json")
+            note "done  phD_distill_serve/$arm -> $line"
+            echo "{\"tag\": \"phD_distill_serve\", \"rc\": 0, \"result\": $line}" >> "$RESULTS"
+        else
+            note "FAIL  phD_distill_serve/$arm rc=$?"
+            echo "{\"tag\": \"phD_distill_serve\", \"rc\": 1, \"result\": {\"arm\": \"$arm\"}}" >> "$RESULTS"
+        fi
+    done
+fi
 
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including

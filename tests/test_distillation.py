@@ -93,6 +93,52 @@ def test_distillation_prototype_mismatch_rejected(tmp_path):
         SSLMetaArch(cfg)
 
 
+def test_load_teacher_params_partial_restore(tmp_path):
+    """``load_teacher_params`` restores ONLY the teacher branch out of a
+    full train-state checkpoint — the partial restore that TypeError'd
+    on a raw ``partial_restore=True`` kwarg under older orbax before the
+    version gate (checkpoint.pytree_restore_args). Fast arm of the @slow
+    end-to-end test below: the teacher state is checkpointed at init,
+    no pretraining step."""
+    from dinov3_tpu.checkpoint import Checkpointer
+    from dinov3_tpu.train import build_train_setup
+    from dinov3_tpu.train.distillation import load_teacher_params
+
+    t_cfg = get_default_config()
+    apply_dot_overrides(t_cfg, SMOL + [
+        "student.arch=vit_test_big",
+        "dino.head_hidden_dim=48", "ibot.head_hidden_dim=48",
+    ])
+    batch = {k: jnp.asarray(v) for k, v in
+             make_synthetic_batch(t_cfg, 4, seed=0).items()}
+    t_setup = build_train_setup(t_cfg, batch)
+    ckpt_dir = str(tmp_path / "teacher_ckpt")
+    ckpt = Checkpointer(ckpt_dir, async_save=False)
+    ckpt.save(1, t_setup.state)
+    ckpt.close()
+
+    cfg = get_default_config()
+    apply_dot_overrides(cfg, SMOL + [
+        "student.arch=vit_test",
+        "distillation.enabled=true",
+        f"distillation.full_cfg_path={_teacher_yaml(tmp_path, hidden=48)}",
+        f"distillation.checkpoint_path={ckpt_dir}",
+    ])
+    setup = build_train_setup(cfg, batch)
+    # different init seeds upstream: the restore must actually overwrite
+    before = jax.tree.leaves(setup.state.params["teacher"])
+    state = load_teacher_params(cfg, setup.state, setup.state_shardings)
+    want = jax.tree.leaves(t_setup.state.params["teacher"])
+    got = jax.tree.leaves(state.params["teacher"])
+    assert len(want) == len(got) == len(before)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+    # student branch untouched
+    for a, b in zip(jax.tree.leaves(setup.state.params["student"]),
+                    jax.tree.leaves(state.params["student"])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
 @pytest.mark.slow
 def test_load_teacher_params_from_checkpoint(tmp_path):
     """Pretrain a tiny teacher, checkpoint it, then restore it as the
