@@ -265,6 +265,14 @@ class Checkpointer:
 
     # -------- local npz backend (one-host subgroups) --------
 
+    # A step is resumable only once this marker exists: every byte of the
+    # payload was flushed BEFORE the marker was written (write-then-
+    # finalize), so a save interrupted at any point — mid-payload,
+    # mid-rename, mid-marker — leaves a directory that latest_step()
+    # refuses to announce, and resume falls back to the previous
+    # finalized step instead of a truncated one.
+    FINALIZED = "FINALIZED"
+
     def _local_steps(self) -> list[int]:
         import os
 
@@ -274,8 +282,12 @@ class Checkpointer:
             int(d) for d in os.listdir(self._directory)
             if d.isdigit()
             # only this backend's layout: a pre-upgrade orbax step dir
-            # must not be announced as resumable
+            # must not be announced as resumable...
             and os.path.exists(os.path.join(self._directory, d, "state.npz"))
+            # ...and only FINALIZED saves: an interrupted/truncated save
+            # never wrote the marker
+            and os.path.exists(
+                os.path.join(self._directory, d, self.FINALIZED))
         )
 
     def _local_save(self, step: int, state: TrainState) -> bool:
@@ -293,6 +305,13 @@ class Checkpointer:
         final = os.path.join(self._directory, str(step))
         os.makedirs(tmp, exist_ok=True)
         np.savez(os.path.join(tmp, "state.npz"), **arrays)
+        # finalize order: payload flushed -> marker -> rename. A kill at
+        # any point leaves either a tmp.* dir (never discovered) or a
+        # digit dir whose marker vouches for a complete payload.
+        with open(os.path.join(tmp, self.FINALIZED), "w") as f:
+            f.write(str(step))
+            f.flush()
+            os.fsync(f.fileno())
         if os.path.isdir(final):  # overwrite-save of the same step
             shutil.rmtree(final)
         os.rename(tmp, final)
@@ -337,12 +356,24 @@ class Checkpointer:
 
     # -------- save --------
 
-    def save(self, step: int, state: TrainState) -> bool:
-        """Async save; returns True if a save was started."""
+    def save(self, step: int, state: TrainState,
+             topology: dict | None = None) -> bool:
+        """Async save; returns True if a save was started.
+
+        ``topology``: JSON-able (mesh, arm) descriptor of the saving run
+        (``parallel.reshard.describe_topology``) — written as a
+        ``topology.json`` sidecar at the checkpoint root so an elastic
+        resume can decide between the in-memory reshard path and the
+        disk path, and so ``scripts/cost_reshard.py`` can report the
+        transition it crossed. The on-disk STATE stays arm-independent
+        regardless (per-leaf moment layout); the sidecar is advisory.
+        """
         if _bucketed_moments(state, self.bucket_plan):
             # persist the per-leaf layout so any arm restores this
             # checkpoint (pure permutation, bitwise)
             state = _moments_to_flat(state, self.bucket_plan)
+        if topology is not None:
+            self._write_topology(step, topology)
         if self._local:
             saved = self._local_save(step, state)
         else:
@@ -354,13 +385,86 @@ class Checkpointer:
             logger.info("checkpoint save started at step %d", step)
         return saved
 
+    def _write_topology(self, step: int, topology: dict) -> None:
+        import json
+        import os
+
+        if jax.process_index() != 0 and not self._local:
+            return
+        os.makedirs(self._directory, exist_ok=True)
+        path = os.path.join(self._directory, "topology.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dict(topology, step=int(step)), f, indent=1)
+        os.replace(tmp, path)
+
+    def saved_topology(self) -> dict | None:
+        """The (mesh, arm) sidecar of the most recent save, or None for
+        pre-elastic checkpoints that never wrote one."""
+        import json
+        import os
+
+        path = os.path.join(self._directory, "topology.json")
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     # -------- restore --------
 
     def latest_step(self) -> int | None:
+        """Newest FINALIZED step, or None.
+
+        Both backends honor write-then-finalize discovery: the local-npz
+        backend requires its ``FINALIZED`` marker (``_local_steps``); the
+        orbax backend re-checks ``manager.all_steps()`` newest-first and
+        skips any step whose directory fails the structural readability
+        probe (``_orbax_step_readable``) — orbax's own tmp-dir atomic
+        rename covers the common interruption, but a save killed during
+        finalization (or a truncated copy/transfer) can leave a
+        digit-named directory missing its item payload or metadata, and
+        ``manager.latest_step()`` would happily announce it. Resume then
+        lands on the newest step that can actually be restored.
+        """
         if self._local:
             steps = self._local_steps()
             return steps[-1] if steps else None
-        return self.manager.latest_step()
+        for step in sorted(self.manager.all_steps(), reverse=True):
+            if self._orbax_step_readable(int(step)):
+                return int(step)
+        return None
+
+    def _orbax_step_readable(self, step: int) -> bool:
+        import os
+
+        root = os.path.join(self._directory, str(step))
+        if not os.path.isdir(root):
+            return False
+        try:
+            fin = getattr(ocp.utils, "is_checkpoint_finalized", None)
+            if fin is not None and not fin(root):
+                return False
+        except ValueError:
+            # orbax raises on tmp-suffixed/unfinalized layouts
+            return False
+        # the "state" item payload must exist and be non-empty — an
+        # interrupted composite save can finalize the step dir before
+        # the item directory has content
+        item = os.path.join(root, "state")
+        if not os.path.isdir(item) or not os.listdir(item):
+            return False
+        # metadata must PARSE: a truncated payload loses its manifest /
+        # _METADATA and the readers raise. None (ancient orbax that
+        # cannot resolve metadata at all) stays permissive — the
+        # structural checks above already ran.
+        try:
+            item_metadata_tree(self.manager, step)
+        except Exception:
+            return False
+        return True
 
     def restore(self, state_like: TrainState, step: int | None = None) -> TrainState:
         """Restore into the sharding/structure of ``state_like``.

@@ -207,6 +207,12 @@ def load_config(
     # well-formed (the live comparison fires from bench/setup paths,
     # which know the device count — warn_tuned_plan_stale dual mode)
     warn_tuned_plan_stale(cfg)
+    # ... and over the elastic-resume knobs: a typo'd resume-topology
+    # policy or an unusable re-padding tolerance must fail at load, not
+    # at the preemption the elastic engine exists to survive (the live
+    # re-padding check fires from parallel/reshard.py, which knows the
+    # leaf sizes — warn_reshard_padding dual mode)
+    warn_reshard_padding(cfg)
     return cfg
 
 
@@ -522,6 +528,80 @@ def warn_update_shard_padding(
 
     warnings.warn(msg, stacklevel=stacklevel + 1)
     return msg
+
+
+def warn_reshard_padding(
+    cfg: ConfigNode | None = None, *, leaf_sizes=None,
+    src_dp: int | None = None, dst_dp: int | None = None,
+    threshold: float | None = None, stacklevel: int = 2,
+) -> list[str]:
+    """Guardrail on elastic topology transitions — the axis-labelled,
+    dual-mode style of ``warn_tuned_plan_stale``.
+
+    **Config mode** (``load_config``, only ``cfg`` given): validates the
+    elastic-resume knobs themselves — ``train.resume_topology`` must
+    name a known path and ``train.reshard_padding_tol`` must be a
+    usable fraction in (0, 1] — so a typo'd policy fails at load, not
+    at the preemption it was meant to survive.
+
+    **Live mode** (``leaf_sizes``/``src_dp``/``dst_dp`` given — fired by
+    ``parallel.reshard.reshard_state`` when a transition re-lays-out the
+    flat/bucketed/zero3 moment leaves, and recorded into bench/chaos
+    JSONs like the PR-9 bucket guardrail): warns when the TARGET
+    topology's shard-divisibility zero-padding exceeds the tolerance —
+    the resized fleet would stream that padding through its 1/dp update
+    shards on every step after the reshape, a permanent tax a one-time
+    reshard decision just signed up for.
+
+    Returns the list of messages ([] when clean)."""
+    import warnings
+
+    msgs = []
+    if leaf_sizes is None:
+        assert cfg is not None
+        policy = str(cfg.train.get("resume_topology", "auto") or "auto")
+        if policy not in ("auto", "memory", "disk"):
+            msgs.append(
+                f"train.resume_topology={policy!r} is not one of "
+                f"auto|memory|disk — the elastic resume would fail at "
+                f"the restore it exists to survive; fix the policy "
+                f"(train/setup.py elastic_resume)."
+            )
+        tol = cfg.train.get("reshard_padding_tol", 0.05)
+        try:
+            tol = float(tol)
+            bad = not (0.0 < tol <= 1.0)
+        except (TypeError, ValueError):
+            bad = True
+        if bad:
+            msgs.append(
+                f"train.reshard_padding_tol={tol!r} is outside (0, 1] — "
+                f"the reshard re-padding guardrail is either always-on "
+                f"noise or dead code; use a fraction like 0.05."
+            )
+        for m in msgs:
+            warnings.warn(m, stacklevel=stacklevel + 1)
+        return msgs
+    if threshold is None:
+        threshold = (float(cfg.train.get("reshard_padding_tol", 0.05))
+                     if cfg is not None else 0.05)
+    src_waste = update_shard_padding_waste(leaf_sizes, int(src_dp or 1))
+    dst_waste = update_shard_padding_waste(leaf_sizes, int(dst_dp))
+    if dst_waste > threshold:
+        msgs.append(
+            f"reshard flat axis: re-padding the moment leaves from "
+            f"dp={src_dp} ({src_waste:.1%} padding) to dp={dst_dp} "
+            f"wastes {dst_waste:.1%} of the flattened size "
+            f"(> {threshold:.0%}) — every replica of the TARGET "
+            f"topology streams that padding through its 1/dp shard on "
+            f"every step after the reshape "
+            f"(train/fused_update.py flatten_update_leaf). Resize to a "
+            f"data-axis size that divides the leaf sizes, or move to a "
+            f"model-shaped arm (replicated/zero3) first."
+        )
+    for m in msgs:
+        warnings.warn(m, stacklevel=stacklevel + 1)
+    return msgs
 
 
 def bucketed_collectives_wished(cfg: ConfigNode) -> bool:

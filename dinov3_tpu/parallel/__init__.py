@@ -21,6 +21,15 @@ from dinov3_tpu.parallel.distributed import (
 )
 from dinov3_tpu.parallel.mesh import MeshSpec, build_mesh
 from dinov3_tpu.parallel.pipeline import PipelinedBlocks, pipe_axis_size
+from dinov3_tpu.parallel.reshard import (
+    RESHARD_SCOPES,
+    TopologyDesc,
+    arm_name,
+    describe_topology,
+    moments_convert_needed,
+    reshard_state,
+    topology_of,
+)
 from dinov3_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_local,
@@ -51,6 +60,13 @@ __all__ = [
     "pipe_axis_size",
     "ring_attention",
     "ring_attention_local",
+    "RESHARD_SCOPES",
+    "TopologyDesc",
+    "arm_name",
+    "describe_topology",
+    "moments_convert_needed",
+    "reshard_state",
+    "topology_of",
     "initialize_distributed",
     "is_main_process",
     "process_count",

@@ -15,17 +15,25 @@ from __future__ import annotations
 import logging
 import signal
 import threading
+import time
 
 logger = logging.getLogger("dinov3")
 
 
 class PreemptionHandler:
-    """Installs SIGTERM/SIGINT handlers; poll ``should_stop()`` per step."""
+    """Installs SIGTERM/SIGINT handlers; poll ``should_stop()`` per step.
+
+    The wall time and name of the first notice are kept
+    (``notice_time`` / ``notice_signal``) so the train loop can put the
+    signal→step-boundary latency into the preemption span chain
+    (telemetry/watchdog.py ``PREEMPT_CHAIN``)."""
 
     def __init__(self, signals=(signal.SIGTERM,)):
         self._stop = threading.Event()
         self._previous = {}
         self._signals = tuple(signals)
+        self.notice_time: float | None = None
+        self.notice_signal: str | None = None
 
     def __enter__(self) -> "PreemptionHandler":
         for sig in self._signals:
@@ -42,6 +50,18 @@ class PreemptionHandler:
             "received signal %s: will checkpoint and exit at the next "
             "step boundary", signal.Signals(signum).name,
         )
+        if self.notice_time is None:  # keep the FIRST notice's clock
+            self.notice_time = time.time()
+            self.notice_signal = signal.Signals(signum).name
+        self._stop.set()
+
+    def notice(self, signal_name: str = "manual") -> None:
+        """Programmatic preemption (chaos harnesses, supervisors): same
+        effect as receiving the signal, without a process-level signal
+        delivery the test runner would race."""
+        if self.notice_time is None:
+            self.notice_time = time.time()
+            self.notice_signal = signal_name
         self._stop.set()
 
     def should_stop(self) -> bool:

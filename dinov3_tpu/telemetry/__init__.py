@@ -70,8 +70,11 @@ from dinov3_tpu.telemetry.serve_obs import (
 from dinov3_tpu.telemetry.spans import SERVE_PHASES, SpanTracer, StepTimer
 from dinov3_tpu.telemetry.trace import Trace, TraceEvent, find_trace_file, load_trace
 from dinov3_tpu.telemetry.watchdog import (
+    PREEMPT_CHAIN,
     Watchdog,
+    emit_preempt_chain,
     heartbeat_path,
+    last_preempt_record,
     read_heartbeat,
     scan_heartbeats,
 )
@@ -93,6 +96,7 @@ __all__ = [
     "LogHistogram", "quantile_nearest_rank",
     "LiveMixTracker", "ServeObserver", "recommended_serve_envelope",
     "Watchdog", "heartbeat_path", "read_heartbeat", "scan_heartbeats",
+    "PREEMPT_CHAIN", "emit_preempt_chain", "last_preempt_record",
     "blocking_fetch", "host_sync_stats",
     "per_device_state_bytes", "sample_memory",
     "telemetry_wished",

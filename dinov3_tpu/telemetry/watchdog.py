@@ -36,6 +36,51 @@ _HB_RE = re.compile(
     r"heartbeat(?:\.(?!rank\d+$)(?P<role>[A-Za-z0-9_-]+))?"
     r"(?:\.rank(?P<rank>\d+))?$")
 
+# The preemption span chain (ISSUE 19): one record per link, all in the
+# same JSONL stream the phase spans live in, crossing the process
+# boundary — the dying incarnation writes the first two links, the
+# resuming one writes the third with ``since_preempt_s`` joined against
+# the newest ``preempt_save`` on disk (``last_preempt_record``). That
+# join IS the preemption-to-resume latency a fleet operator pages on.
+PREEMPT_CHAIN = ("preempt_notice", "preempt_save", "resume_restore")
+
+
+def emit_preempt_chain(tracer, name: str, iteration: int,
+                       **fields) -> dict:
+    """Emit one link of ``PREEMPT_CHAIN`` through ``tracer`` (no-op
+    returning the record when the tracer is None/disabled)."""
+    assert name in PREEMPT_CHAIN, name
+    rec = {"name": name, "iteration": int(iteration),
+           "t": round(time.time(), 6), **fields}
+    if tracer is not None:
+        tracer.emit(rec)
+    return rec
+
+
+def last_preempt_record(output_dir: str,
+                        name: str = "preempt_save") -> dict | None:
+    """The newest ``name`` chain record across every span stream under
+    ``output_dir/telemetry`` (all roles/ranks), or None. Torn trailing
+    lines — the usual state of a stream whose writer was preempted —
+    are skipped, not fatal."""
+    best = None
+    for path in glob.glob(
+            os.path.join(output_dir, "telemetry", "spans*.jsonl")):
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("name") != name:
+                        continue
+                    if best is None or rec.get("t", 0) >= best.get("t", 0):
+                        best = rec
+        except OSError:
+            continue
+    return best
+
 
 def heartbeat_path(output_dir: str, role: str = "train",
                    rank: int = 0) -> str:

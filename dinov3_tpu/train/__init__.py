@@ -24,7 +24,12 @@ from dinov3_tpu.train.schedules import (
     cosine_schedule,
     linear_warmup_cosine_decay,
 )
-from dinov3_tpu.train.setup import TrainSetup, build_train_setup, put_batch
+from dinov3_tpu.train.setup import (
+    TrainSetup,
+    build_train_setup,
+    elastic_resume,
+    put_batch,
+)
 from dinov3_tpu.train.ssl_meta_arch import SSLMetaArch
 from dinov3_tpu.train.train_step import TrainState, make_train_step
 
@@ -39,6 +44,6 @@ __all__ = [
     "scheduled_adamw",
     "build_multiplier_trees", "Schedules", "build_schedules",
     "cosine_schedule", "linear_warmup_cosine_decay",
-    "TrainSetup", "build_train_setup", "put_batch",
+    "TrainSetup", "build_train_setup", "elastic_resume", "put_batch",
     "SSLMetaArch", "TrainState", "make_train_step",
 ]

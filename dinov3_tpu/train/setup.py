@@ -686,6 +686,45 @@ def _build_train_setup(
     )
 
 
+def elastic_resume(setup, ckpt, *, live_state=None, live_topology=None,
+                   policy: str = "auto", tracer=None):
+    """Topology-elastic resume into a freshly built ``setup``.
+
+    Two paths produce bitwise-identical states (tests/test_reshard.py):
+
+    - **memory** — a still-live ``TrainState`` from a previous
+      incarnation in this process (an elastic resize without preemption)
+      is resharded in place by ``parallel.reshard.reshard_state``: one
+      scoped collective program per leaf-group, no disk round-trip.
+      Requires ``live_state``/``live_topology`` and, under ``auto``,
+      every device of the OLD mesh still visible to this process.
+    - **disk** — ``ckpt.restore`` through the arm-adapting checkpoint
+      path (a real preemption: the old process and its arrays are gone).
+
+    Returns ``(state, info)``; ``info["path"]`` says which path ran, and
+    the memory path attaches the full per-group reshard ``report``
+    (censuses, wall times) for the span stream / cost harness.
+    """
+    from dinov3_tpu.parallel.reshard import reshard_state, topology_of
+
+    if policy not in ("auto", "memory", "disk"):
+        raise ValueError(f"unknown resume-topology policy {policy!r}")
+    live_ok = live_state is not None and live_topology is not None
+    if policy == "memory" and not live_ok:
+        raise ValueError(
+            "--resume-topology memory needs a live state from the "
+            "previous incarnation; after a real preemption use "
+            "auto/disk (checkpoint path)")
+    reachable = live_ok and {
+        d.id for d in live_topology.mesh.devices.flat
+    } <= {d.id for d in jax.devices()}
+    if policy == "memory" or (policy == "auto" and live_ok and reachable):
+        state, report = reshard_state(
+            live_state, live_topology, topology_of(setup), tracer=tracer)
+        return state, {"path": "memory", "report": report}
+    return ckpt.restore(setup.state), {"path": "disk"}
+
+
 def put_batch(batch: dict, batch_shardings: dict) -> dict:
     """Host batch -> sharded device arrays (each host feeds its shard).
 

@@ -85,6 +85,17 @@ def get_args_parser():
                    help="enable jax_debug_nans: the first op producing a "
                         "NaN raises with its location (slower; de-fuses "
                         "the step for op-level blame)")
+    p.add_argument("--resume-topology", default="auto",
+                   choices=("auto", "memory", "disk"),
+                   help="topology-elastic resume path when the run "
+                        "resumes under a different (mesh, arm) than the "
+                        "one that saved: 'memory' reshards a still-live "
+                        "train state in place (parallel/reshard.py — a "
+                        "resize without preemption, no disk round-trip), "
+                        "'disk' always restores through the checkpoint "
+                        "adapter, 'auto' picks memory whenever a live "
+                        "state is supplied and its mesh is still "
+                        "reachable")
     p.add_argument("opts", nargs="*", default=[],
                    help="key.path=value config overrides")
     return p
@@ -148,12 +159,22 @@ def build_data_iterator(cfg, global_batch_size: int, rank: int = 0,
 
 
 def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
-             process_group=None, group_name=None) -> dict:
+             process_group=None, group_name=None, live_state=None,
+             live_topology=None) -> dict:
     """Train one model. With the keyword arguments a multidistillation
     subgroup trains its student on a device-subset mesh: ``devices`` are
     the group's devices, ``data_rank``/``data_world`` its host-shard
     coordinates, ``process_group`` its process indices (checkpoint barrier
-    scope)."""
+    scope).
+
+    ``live_state``/``live_topology``: a still-live ``TrainState`` and its
+    ``TopologyDesc`` from a previous incarnation in THIS process (an
+    elastic supervisor resizing without preemption — scripts/
+    cost_reshard.py drives exactly this). Under ``--resume-topology
+    auto|memory`` the resume reshards it in memory
+    (``parallel/reshard.py``) instead of round-tripping through disk;
+    a real preemption (process death) leaves them None and the
+    checkpoint path restores across the topology change instead."""
     from dinov3_tpu.configs import global_batch_size
     from dinov3_tpu.parallel import process_count, process_index
 
@@ -170,11 +191,14 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         sync_prefix=group_name,
     )
     # the resume point decides where the data stream starts, so it must be
-    # known before the iterator is built
+    # known before the iterator is built. A live in-memory state (elastic
+    # resize without preemption) resumes even with no checkpoint on disk.
     start_iter = 0
-    resuming = not args.no_resume and ckpt.latest_step() is not None
+    resuming = not args.no_resume and (
+        ckpt.latest_step() is not None or live_state is not None)
     if resuming:
-        start_iter = int(ckpt.latest_step())
+        start_iter = (int(live_state.step) if live_state is not None
+                      else int(ckpt.latest_step()))
 
     data_iter = build_data_iterator(cfg, B, rank=rank, world_size=world,
                                     start_iter=start_iter)
@@ -211,6 +235,12 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     # layout; the checkpointer needs the plan to convert to/from the
     # per-leaf on-disk layout (checkpoint.py)
     ckpt.bucket_plan = getattr(setup, "bucket_plan", None)
+    # the (mesh, arm) sidecar every save carries — an elastic resume (or
+    # scripts/cost_reshard.py) reads it to know which transition it is
+    # about to cross
+    from dinov3_tpu.parallel.reshard import describe_topology, topology_of
+
+    run_topology = describe_topology(topology_of(setup))
     logger.info(
         "mesh %s | global batch %d | %d devices x %d hosts | setup %.1fs",
         dict(setup.mesh.shape), B, n_devices, world, time.perf_counter() - t0,
@@ -242,8 +272,20 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
         total_iters = min(total_iters, args.max_iterations)
 
     state = setup.state
+    restore_s = 0.0
+    resume_info = None
     if resuming:
-        state = ckpt.restore(state)
+        from dinov3_tpu.train.setup import elastic_resume
+
+        t_res = time.perf_counter()
+        state, resume_info = elastic_resume(
+            setup, ckpt,
+            live_state=live_state, live_topology=live_topology,
+            policy=getattr(args, "resume_topology", "auto") or "auto",
+        )
+        restore_s = time.perf_counter() - t_res
+        logger.info("elastic resume via %s path (%.2fs)",
+                    resume_info["path"], restore_s)
         if int(state.step) != start_iter:
             # a partially-committed async save can be cleaned up between
             # latest_step() and restore(); realign the data stream with
@@ -366,6 +408,22 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     # same stream the phase spans live in (0 = disabled)
     watchdog = Watchdog(tracer, deadline_s=float(
         tele_cfg.get("flush_deadline_s", 0.0) or 0.0))
+    from dinov3_tpu.telemetry import emit_preempt_chain, last_preempt_record
+
+    if resuming and tracer.enabled:
+        # third link of the preemption span chain: the restore happened
+        # before the tracer could exist (it decides the resume step), so
+        # the measured duration is emitted post-hoc; joining against the
+        # dead incarnation's preempt_save record on the same stream
+        # yields the preemption-to-resume latency
+        prev_save = last_preempt_record(cfg.train.output_dir,
+                                        "preempt_save")
+        rec = {"dur_ms": round(restore_s * 1e3, 4),
+               "path": resume_info["path"] if resume_info else "disk"}
+        if prev_save is not None:
+            rec["since_preempt_s"] = round(
+                time.time() - float(prev_save["t"]), 3)
+        emit_preempt_chain(tracer, "resume_restore", start_iter, **rec)
     memory_on = bool(tele_cfg.get("memory", True)) and tracer.enabled
     if memory_on:
         tracer.emit_memory("setup")
@@ -569,6 +627,13 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
                     f.write(_json.dumps(
                         {"iteration": it + 1, **results}) + "\n")
         stopping = preemption.should_stop()
+        if stopping:
+            # first link of the chain: dur_ms = signal -> step boundary
+            notice_t = preemption.notice_time or time.time()
+            emit_preempt_chain(
+                tracer, "preempt_notice", it,
+                signal=preemption.notice_signal or "unknown",
+                dur_ms=round((time.time() - notice_t) * 1e3, 4))
         if plan is not None and (
             it + 1 - reader.cursor >= plan.ring_len
             or it + 1 >= total_iters
@@ -583,8 +648,17 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
             or it + 1 == total_iters
             or stopping
         ):
+            t_save = time.time()
             with tracer.span("checkpoint_save", it):
-                ckpt.save(it + 1, state)
+                ckpt.save(it + 1, state, topology=run_topology)
+            if stopping:
+                # second link: the final atomic save must be DURABLE
+                # (finalize marker written) before the process dies —
+                # dur_ms covers the save dispatch + finalization wait
+                ckpt.wait_until_finished()
+                emit_preempt_chain(
+                    tracer, "preempt_save", it, step=it + 1,
+                    dur_ms=round((time.time() - t_save) * 1e3, 4))
         if stopping:
             logger.warning("preempted: checkpointed at iteration %d, "
                            "exiting for requeue", it + 1)
@@ -598,6 +672,12 @@ def do_train(cfg, args, *, devices=None, data_rank=None, data_world=None,
     tracer.close()
     ckpt.close()
     result = {"final_loss": last_loss, "iterations": int(state.step)}
+    if getattr(args, "keep_state", False):
+        # elastic-supervisor handle (scripts/cost_reshard.py): the live
+        # state and its TopologyDesc outlive the incarnation so the next
+        # one can reshard in memory instead of round-tripping disk
+        result["state"] = state
+        result["topology"] = topology_of(setup)
     if teacher_server is not None:
         result["teacher_serve"] = teacher_server.stats()
         logger.info("serve-backed teacher: %s", result["teacher_serve"])

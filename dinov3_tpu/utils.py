@@ -496,6 +496,17 @@ HLO_COLLECTIVE_SCOPES = (
     # replicated-per-host and GSPMD reshards them onto the batch axes —
     # those copies/collectives belong to the fan-out, not "other"
     ("distill_fanout", "distill_fanout"),
+    # the elastic-topology engine (parallel/reshard.py): one scope per
+    # train-state leaf-group, wrapping the WHOLE per-group program —
+    # the arm-layout conversion (flat <-> model <-> bucketed moment
+    # reshapes) and the src->dst sharding constraint — so every
+    # collective a live mesh/arm transition inserts is attributed to
+    # the group that moved, and the zero-unattributed pin holds across
+    # reshard censuses exactly as it does for train steps
+    ("reshard_params", "reshard_params"),
+    ("reshard_mu", "reshard_mu"),
+    ("reshard_nu", "reshard_nu"),
+    ("reshard_rest", "reshard_rest"),
     ("telemetry_ring", "telemetry"),
 )
 

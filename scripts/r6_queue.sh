@@ -457,6 +457,28 @@ PY
     done
 fi
 
+# phR: elastic-topology reshard A/B on chip (PR 19). The full chaos
+# harness on the real mesh: one run killed/resumed across three
+# topologies with the loss trajectory pinned bitwise vs the unreshaped
+# oracle, plus the in-memory-vs-disk transition instrument — on chip
+# the state is real-sized, so the memory-vs-disk gap (and whether the
+# one-time program compile amortizes as predicted) is the banked
+# number. Artifact rides RESULTS for the next session to commit as the
+# on-chip RESHARD row.
+if gate_phase 3000 phR_reshard_elastic; then
+    note "start phR_reshard_elastic"
+    rm -f /tmp/reshard_r6.json
+    if timeout 3000 python scripts/cost_reshard.py /tmp/reshard_r6.json \
+            >> "$LOG" 2>&1; then
+        note "done  phR_reshard_elastic -> /tmp/reshard_r6.json"
+        line=$(python -c "import json; print(json.dumps(json.load(open('/tmp/reshard_r6.json'))))")
+        echo "{\"tag\": \"phR_reshard_elastic\", \"rc\": 0, \"result\": $line}" >> "$RESULTS"
+    else
+        note "FAIL  phR_reshard_elastic rc=$?"
+        echo "{\"tag\": \"phR_reshard_elastic\", \"rc\": 1, \"result\": null}" >> "$RESULTS"
+    fi
+fi
+
 # phG2: the fixed op-level flash-vs-dense crossover (compiles in
 # seconds; measures the kernels.flash_min_seq=2048 boundary including
 # the unmeasured 2048-2309 band and the flash side at N>=2309).

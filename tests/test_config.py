@@ -187,3 +187,36 @@ def test_batch_tiling_guardrail_at_config_build():
         load_config(overrides=["train.batch_size_per_device=12",
                                "optim.scaling_rule=none"])
         assert not any("sublane" in str(w.message) for w in caught)
+
+
+def test_reshard_guardrail_config_and_live_modes():
+    """warn_reshard_padding (ISSUE 19): config mode rejects typo'd
+    elastic-resume knobs at load; live mode prices the target
+    topology's flat-shard re-padding on a reshape."""
+    import warnings
+
+    from dinov3_tpu.configs.config import warn_reshard_padding
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        load_config(overrides=["train.resume_topology=sideways",
+                               "train.reshard_padding_tol=7",
+                               "optim.scaling_rule=none"])
+        text = " ".join(str(w.message) for w in caught)
+        assert "resume_topology" in text and "reshard_padding_tol" in text
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        load_config(overrides=["train.resume_topology=memory",
+                               "optim.scaling_rule=none"])
+        assert not any("resume_topology" in str(w.message)
+                       for w in caught)
+
+    # live mode: 7 elements at dp=8 pad 1/8; clean at dp=7
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        msgs = warn_reshard_padding(leaf_sizes=[7], src_dp=7, dst_dp=8,
+                                    threshold=0.05)
+        assert len(msgs) == 1 and "dp=8" in msgs[0]
+        assert any("re-padding" in str(w.message) for w in caught)
+    assert warn_reshard_padding(leaf_sizes=[7], src_dp=8, dst_dp=7,
+                                threshold=0.05) == []

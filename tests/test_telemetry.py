@@ -504,3 +504,104 @@ def test_loss_tools_consume_flushed_batches(tmp_path):
     assert ml.meters["total_loss"].count == 3
     assert ml.meters["total_loss"].value == pytest.approx(1.125)
     assert ml.meters["lr"].value == pytest.approx(0.5)
+
+
+# ---------------- preemption chain + heartbeat scan (ISSUE 19) ----------------
+
+def test_scan_heartbeats_mixed_legacy_and_namespaced(tmp_path):
+    """A dir holding BOTH pre-PR-11 un-namespaced heartbeats and
+    namespaced ones: legacy files report role "train" with
+    ``legacy=True``, a namespaced beat shadows the legacy file for the
+    same (role, rank), and staleness is judged per file."""
+    from dinov3_tpu.telemetry import scan_heartbeats
+
+    tdir = tmp_path / "telemetry"
+    os.makedirs(tdir)
+    now = time.time()
+    for name, age in [
+        ("heartbeat", 100.0),          # legacy (train, 0) — shadowed
+        ("heartbeat.train", 1.0),      # namespaced (train, 0) — fresh
+        ("heartbeat.rank1", 50.0),     # legacy (train, 1) — survives
+        ("heartbeat.serve.rank2", 2.0),
+    ]:
+        p = tdir / name
+        p.write_text("beat\n")
+        os.utime(p, (now - age, now - age))
+
+    rows = scan_heartbeats(str(tmp_path), stale_after_s=10.0, now=now)
+    by_key = {(r["role"], r["rank"]): r for r in rows}
+    assert set(by_key) == {("serve", 2), ("train", 0), ("train", 1)}
+    t0 = by_key[("train", 0)]
+    assert not t0["legacy"] and not t0["stalled"]  # namespaced shadows
+    assert t0["path"].endswith("heartbeat.train")
+    t1 = by_key[("train", 1)]
+    assert t1["legacy"] and t1["stalled"]
+    assert not by_key[("serve", 2)]["stalled"]
+
+
+def test_preempt_chain_spans_roundtrip(tmp_path):
+    """preempt_notice -> preempt_save -> resume_restore: each link
+    emitted through the tracer lands in the span JSONL with the chain
+    schema, and ``last_preempt_record`` recovers the newest save record
+    across streams even past a torn trailing line (the usual state of a
+    preempted writer's file)."""
+    from dinov3_tpu.telemetry import (
+        PREEMPT_CHAIN,
+        SpanTracer,
+        emit_preempt_chain,
+        last_preempt_record,
+    )
+
+    assert PREEMPT_CHAIN == (
+        "preempt_notice", "preempt_save", "resume_restore")
+
+    tracer = SpanTracer(str(tmp_path), flush_every_emits=1)
+    emit_preempt_chain(tracer, "preempt_notice", 7, signal="SIGTERM",
+                       dur_ms=3.5)
+    emit_preempt_chain(tracer, "preempt_save", 7, step=8, dur_ms=42.0)
+    tracer.close()
+
+    # a second (serve-role) stream with an older save + a torn line
+    serve = SpanTracer(str(tmp_path), role="serve", flush_every_emits=1)
+    rec = emit_preempt_chain(serve, "preempt_save", 3, step=4)
+    serve.close()
+    with open(serve.spans_path, "a") as f:
+        f.write('{"name": "preempt_save", "t": 9')  # torn mid-record
+
+    # hand the older record an earlier clock so "newest" is meaningful
+    lines = [json.loads(l) for l in open(serve.spans_path).readlines()[:-1]]
+    lines[0]["t"] = rec["t"] - 60.0
+    with open(serve.spans_path, "w") as f:
+        for l in lines:
+            f.write(json.dumps(l) + "\n")
+        f.write('{"name": "preempt_save", "t": 9')
+
+    got = last_preempt_record(str(tmp_path))
+    assert got["name"] == "preempt_save" and got["step"] == 8
+    assert got["iteration"] == 7 and got["role"] == "train"
+    notice = last_preempt_record(str(tmp_path), "preempt_notice")
+    assert notice["signal"] == "SIGTERM"
+    assert last_preempt_record(str(tmp_path), "resume_restore") is None
+
+    # tracer=None (spans disabled): record still built for the caller
+    off = emit_preempt_chain(None, "resume_restore", 0, path="disk")
+    assert off["path"] == "disk" and "t" in off
+    with pytest.raises(AssertionError):
+        emit_preempt_chain(None, "not_a_link", 0)
+
+
+def test_preemption_handler_manual_notice():
+    """PreemptionHandler.notice() — the programmatic path chaos
+    harnesses use — trips the same stop + first-notice clock the signal
+    path records."""
+    from dinov3_tpu.run.preemption import PreemptionHandler
+
+    h = PreemptionHandler()  # signal hooks only install in __enter__
+    assert not h.should_stop() and h.notice_time is None
+    t0 = time.time()
+    h.notice("chaos_kill")
+    assert h.should_stop() and h.notice_signal == "chaos_kill"
+    assert h.notice_time is not None and h.notice_time >= t0
+    first = h.notice_time
+    h.notice("second")  # later notices keep the FIRST clock
+    assert h.notice_time == first and h.notice_signal == "chaos_kill"
